@@ -1,0 +1,100 @@
+"""Shared fixtures for the self-validating randomized test harness.
+
+Mirrors the reference's test strategy (SURVEY.md §4; e.g.
+``tests/graph_tests_gpu/test_graph_gpu_1.cpp:191-207``): run the same
+topology several times with randomized operator parallelisms and batch
+sizes; every run must produce the identical checksum. Sources carve the key
+space per replica (disjoint keys per source replica) so per-key order — and
+therefore running-state checksums — are parallelism-invariant.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class TupleT:
+    key: int
+    value: int
+    ts: int = 0  # event time (µs) when EVENT_TIME sources are used
+
+
+class GlobalSum:
+    """Sink-side accumulator (the reference's ``atomic<long> global_sum``)."""
+
+    def __init__(self) -> None:
+        self._v = 0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: int) -> None:
+        with self._lock:
+            self._v += int(v)
+            self._n += 1
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._v = 0
+            self._n = 0
+
+
+def make_ingress_source(n_keys: int, stream_len: int):
+    """Riched source: replica i generates the full sequence for keys
+    ``k ≡ i (mod parallelism)`` — total stream invariant under parallelism."""
+
+    def src(shipper, ctx):
+        for k in range(ctx.get_replica_index(), n_keys, ctx.get_parallelism()):
+            for i in range(stream_len):
+                shipper.push(TupleT(key=k, value=i + 1))
+
+    return src
+
+
+def make_event_time_source(n_keys: int, stream_len: int, seed: int = 0,
+                           max_step_us: int = 500, disorder_us: int = 0):
+    """EVENT_TIME source with explicit timestamps + watermarks; random ts
+    increments create realistic (bounded) disorder like
+    ``graph_common_gpu.hpp:95-101``."""
+
+    def src(shipper, ctx):
+        rng = random.Random(seed + ctx.get_replica_index())
+        ts = 0
+        for i in range(stream_len):
+            for k in range(ctx.get_replica_index(), n_keys,
+                           ctx.get_parallelism()):
+                jitter = rng.randint(0, disorder_us) if disorder_us else 0
+                t = TupleT(key=k, value=i + 1, ts=ts + jitter)
+                shipper.push_with_timestamp(t, t.ts)
+            shipper.set_next_watermark(max(0, ts - disorder_us))
+            ts += rng.randint(1, max_step_us)
+
+    return src
+
+
+def make_sum_sink(acc: GlobalSum):
+    def sink(t):
+        if t is not None:
+            acc.add(t.value)
+
+    return sink
+
+
+def rand_degree(rng: random.Random, lo: int = 1, hi: int = 4) -> int:
+    return rng.randint(lo, hi)
+
+
+def rand_batch(rng: random.Random) -> int:
+    return rng.choice([0, 0, 1, 4, 32])

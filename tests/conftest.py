@@ -1,0 +1,10 @@
+"""Test environment: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding paths compile and execute without TPU hardware.
+Must run before any jax import (pytest loads conftest first)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,154 @@
+"""Linear MultiPipe tests (reference tests/graph_tests style): randomized
+parallelisms + batch sizes, run-to-run checksum equality, thread-count
+assertions, all execution modes."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Filter_Builder, FlatMap_Builder,
+                          Map_Builder, PipeGraph, Reduce_Builder, Sink_Builder,
+                          Source_Builder, TimePolicy)
+
+from common import (GlobalSum, TupleT, make_ingress_source, make_sum_sink,
+                    rand_batch, rand_degree)
+
+N_KEYS = 7
+STREAM_LEN = 50
+RUNS = 6
+
+
+def build_and_run(mode, rng, acc, chain=False):
+    graph = PipeGraph("test_graph", mode, TimePolicy.INGRESS_TIME)
+    src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng))
+           .with_output_batch_size(rand_batch(rng))
+           .build())
+    mp = graph.add_source(src)
+    map_op = (Map_Builder(lambda t: TupleT(t.key, t.value * 2, t.ts))
+              .with_parallelism(rand_degree(rng))
+              .with_output_batch_size(rand_batch(rng))
+              .build())
+    mp = mp.chain(map_op) if chain else mp.add(map_op)
+    filt = (Filter_Builder(lambda t: t.value % 3 != 0)
+            .with_parallelism(rand_degree(rng))
+            .with_output_batch_size(rand_batch(rng))
+            .build())
+    mp = mp.chain(filt) if chain else mp.add(filt)
+    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(rand_degree(rng)).build()
+    mp.add_sink(sink)
+    graph.run()
+    return graph
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("chain", [False, True])
+def test_map_filter_checksum_invariance(mode, chain):
+    rng = random.Random(1234 + (1 if chain else 0))
+    last = None
+    for r in range(RUNS):
+        acc = GlobalSum()
+        build_and_run(mode, rng, acc, chain)
+        if last is None:
+            last = (acc.value, acc.count)
+        else:
+            assert (acc.value, acc.count) == last, f"run {r} diverged"
+    # direct check: sum of 2*v for v in 1..STREAM_LEN where 2v % 3 != 0, per key
+    expected = N_KEYS * sum(2 * v for v in range(1, STREAM_LEN + 1)
+                            if (2 * v) % 3 != 0)
+    assert last[0] == expected
+
+
+def test_flatmap_reduce_keyby():
+    rng = random.Random(99)
+    last = None
+    for r in range(RUNS):
+        acc = GlobalSum()
+        graph = PipeGraph("fm_reduce", ExecutionMode.DEFAULT,
+                          TimePolicy.INGRESS_TIME)
+        src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(rand_batch(rng)).build())
+
+        def fm(t, shipper):
+            shipper.push(TupleT(t.key, t.value))
+            if t.value % 2 == 0:
+                shipper.push(TupleT(t.key, -t.value))
+
+        # keyby into the flatmap keeps each key on a single path, so the
+        # keyed running-state checksum is order-deterministic (DEFAULT mode
+        # guarantees no cross-replica order, same as the reference)
+        fmap = (FlatMap_Builder(fm).with_key_by(lambda t: t.key)
+                .with_parallelism(rand_degree(rng))
+                .with_output_batch_size(rand_batch(rng)).build())
+
+        def red(t, state):
+            state.value += t.value
+            return state
+
+        reduce_op = (Reduce_Builder(red)
+                     .with_key_by(lambda t: t.key)
+                     .with_initial_state(TupleT(0, 0))
+                     .with_parallelism(rand_degree(rng))
+                     .with_output_batch_size(rand_batch(rng)).build())
+        sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(
+            rand_degree(rng)).build()
+        graph.add_source(src).add(fmap).add(reduce_op).add_sink(sink)
+        graph.run()
+        if last is None:
+            last = (acc.value, acc.count)
+        else:
+            assert (acc.value, acc.count) == last, f"run {r} diverged"
+
+
+def test_chaining_thread_count():
+    """Chained FORWARD same-parallelism stages share one thread
+    (``wf/multipipe.hpp:569-585``); the reference asserts exact thread
+    counts (test_graph_gpu_1.cpp:122-191)."""
+    acc = GlobalSum()
+    graph = PipeGraph("chain_threads")
+    src = (Source_Builder(make_ingress_source(3, 10))
+           .with_parallelism(2).build())
+    m1 = Map_Builder(lambda t: t).with_parallelism(2).build()
+    m2 = Map_Builder(lambda t: t).with_parallelism(2).build()
+    f1 = Filter_Builder(lambda t: True).with_parallelism(3).build()
+    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(3).build()
+    mp = graph.add_source(src)
+    mp.chain(m1)       # fused with source (2 threads total so far)
+    mp.chain(m2)       # still fused
+    mp.add(f1)         # shuffle: 3 new threads
+    mp.chain_sink(sink)  # fused with f1
+    assert graph.get_num_threads() == 2 + 3
+    graph.run()
+    assert acc.count == 3 * 10
+
+
+def test_sink_receives_eos_none():
+    seen = []
+
+    def sink_fn(t):
+        seen.append(t)
+
+    graph = PipeGraph("eos")
+    src = Source_Builder(make_ingress_source(1, 5)).build()
+    graph.add_source(src).add_sink(Sink_Builder(sink_fn).build())
+    graph.run()
+    assert seen[-1] is None
+    assert len([x for x in seen if x is not None]) == 5
+
+
+def test_stats_collection():
+    acc = GlobalSum()
+    graph = PipeGraph("stats")
+    src = Source_Builder(make_ingress_source(2, 20)).with_parallelism(2).build()
+    m = Map_Builder(lambda t: t).with_parallelism(2).build()
+    sink = Sink_Builder(make_sum_sink(acc)).build()
+    graph.add_source(src).add(m).add_sink(sink)
+    graph.run()
+    stats = graph.get_stats()
+    map_stats = [o for o in stats["Operators"] if o["kind"] == "Map"][0]
+    assert sum(r["Inputs_received"] for r in map_stats["replicas"]) == 2 * 20
+    assert stats["Threads"] == graph.get_num_threads()
+    dot = graph.to_dot()
+    assert "->" in dot

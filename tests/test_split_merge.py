@@ -1,0 +1,140 @@
+"""Split/merge PipeGraph tests (reference tests/split_tests, merge_tests):
+branching DAGs with randomized degrees, checksum invariance across runs."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
+                          PipeGraph, Sink_Builder, Source_Builder, TimePolicy,
+                          WindFlowError)
+
+from common import (GlobalSum, TupleT, make_ingress_source, make_sum_sink,
+                    rand_batch, rand_degree)
+
+N_KEYS = 6
+STREAM_LEN = 40
+RUNS = 5
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_split_two_branches(mode):
+    """Even values to branch 0 (doubled), odd to branch 1 (negated)."""
+    rng = random.Random(7)
+    last = None
+    for r in range(RUNS):
+        acc0, acc1 = GlobalSum(), GlobalSum()
+        graph = PipeGraph("split2", mode)
+        src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(rand_batch(rng)).build())
+        mp = graph.add_source(src)
+        mp.split(lambda t: 0 if t.value % 2 == 0 else 1, 2)
+        b0 = mp.select(0)
+        b0.add(Map_Builder(lambda t: TupleT(t.key, t.value * 2))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(rand_batch(rng)).build())
+        b0.add_sink(Sink_Builder(make_sum_sink(acc0))
+                    .with_parallelism(rand_degree(rng)).build())
+        b1 = mp.select(1)
+        b1.add(Map_Builder(lambda t: TupleT(t.key, -t.value))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(rand_batch(rng)).build())
+        b1.add_sink(Sink_Builder(make_sum_sink(acc1))
+                    .with_parallelism(rand_degree(rng)).build())
+        graph.run()
+        cur = (acc0.value, acc1.value, acc0.count, acc1.count)
+        if last is None:
+            last = cur
+        else:
+            assert cur == last, f"run {r} diverged"
+    evens = sum(v for v in range(1, STREAM_LEN + 1) if v % 2 == 0)
+    odds = sum(v for v in range(1, STREAM_LEN + 1) if v % 2 == 1)
+    assert last[0] == N_KEYS * 2 * evens
+    assert last[1] == -N_KEYS * odds
+
+
+def test_split_broadcast_indices():
+    """Splitting logic may return multiple branch indices (tuple copied to
+    several branches, ``wf/splitting_emitter.hpp``)."""
+    accA, accB = GlobalSum(), GlobalSum()
+    graph = PipeGraph("split_multi")
+    src = Source_Builder(make_ingress_source(2, 10)).build()
+    mp = graph.add_source(src)
+    mp.split(lambda t: [0, 1] if t.value % 5 == 0 else [0], 2)
+    mp.select(0).add_sink(Sink_Builder(make_sum_sink(accA)).build())
+    mp.select(1).add_sink(Sink_Builder(make_sum_sink(accB)).build())
+    graph.run()
+    assert accA.count == 2 * 10
+    assert accB.count == 2 * 2  # values 5 and 10 per key
+    assert accB.value == 2 * 15
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_merge_two_pipes(mode):
+    rng = random.Random(21)
+    last = None
+    for r in range(RUNS):
+        acc = GlobalSum()
+        graph = PipeGraph("merge2", mode)
+        src1 = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+                .with_parallelism(rand_degree(rng))
+                .with_output_batch_size(rand_batch(rng)).build())
+        src2 = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+                .with_parallelism(rand_degree(rng))
+                .with_output_batch_size(rand_batch(rng)).build())
+        mp1 = graph.add_source(src1)
+        mp1.add(Map_Builder(lambda t: TupleT(t.key, t.value * 10))
+                .with_parallelism(rand_degree(rng)).build())
+        mp2 = graph.add_source(src2)
+        mp2.add(Filter_Builder(lambda t: t.value % 2 == 0)
+                .with_parallelism(rand_degree(rng)).build())
+        merged = mp1.merge(mp2)
+        merged.add_sink(Sink_Builder(make_sum_sink(acc))
+                        .with_parallelism(rand_degree(rng)).build())
+        graph.run()
+        if last is None:
+            last = (acc.value, acc.count)
+        else:
+            assert (acc.value, acc.count) == last, f"run {r} diverged"
+    tot = sum(range(1, STREAM_LEN + 1))
+    evens = sum(v for v in range(1, STREAM_LEN + 1) if v % 2 == 0)
+    assert last[0] == N_KEYS * (10 * tot + evens)
+
+
+def test_split_then_merge_diamond():
+    """Diamond: split into two transformed branches, merge back to one sink."""
+    acc = GlobalSum()
+    graph = PipeGraph("diamond")
+    src = Source_Builder(make_ingress_source(4, 30)).with_parallelism(2).build()
+    mp = graph.add_source(src)
+    mp.split(lambda t: t.value % 2, 2)
+    b0 = mp.select(0).add(Map_Builder(lambda t: TupleT(t.key, t.value)).build())
+    b1 = mp.select(1).add(Map_Builder(lambda t: TupleT(t.key, 1000 * t.value)).build())
+    b0.merge(b1).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    evens = sum(v for v in range(1, 31) if v % 2 == 0)
+    odds = sum(v for v in range(1, 31) if v % 2 == 1)
+    assert acc.value == 4 * (evens + 1000 * odds)
+    assert acc.count == 4 * 30
+
+
+def test_topology_misuse_raises():
+    graph = PipeGraph("misuse")
+    src = Source_Builder(make_ingress_source(1, 1)).build()
+    mp = graph.add_source(src)
+    sink = Sink_Builder(lambda t: None).build()
+    mp.add_sink(sink)
+    with pytest.raises(WindFlowError):
+        mp.add(Map_Builder(lambda t: t).build())  # after sink
+    with pytest.raises(WindFlowError):
+        graph.add_source(src)  # operator reuse
+    g2 = PipeGraph("empty")
+    with pytest.raises(WindFlowError):
+        g2.run()
+    g3 = PipeGraph("nosink")
+    g3.add_source(Source_Builder(make_ingress_source(1, 1)).build())
+    with pytest.raises(WindFlowError):
+        g3.run()

@@ -1,0 +1,41 @@
+"""windflow_tpu — a TPU-native data-stream-processing framework.
+
+Same capabilities as ParaGroup/WindFlow (Storm/Flink-style operators over
+micro-batched streams, watermark-based out-of-order handling, four window
+parallelism strategies, DAG composition via MultiPipe/PipeGraph, fluent
+builders) with the CUDA device plane replaced by a JAX/XLA one: micro-batches
+are staged into TPU HBM as columnar arrays, per-batch operator functors are
+JIT-compiled XLA programs, keyed shuffles become sort/segment programs, and
+the FlatFAT sliding-window tree is a batched segment tree in HBM
+(``Ffat_Windows_TPU``). Multi-chip scale-out (a surface the single-node
+reference lacks) shards keyed state over a ``jax.sharding.Mesh``.
+
+Import layering: ``import windflow_tpu`` pulls only the CPU plane (no jax);
+``windflow_tpu.tpu`` loads the device plane lazily.
+"""
+
+from .basic import (ExecutionMode, JoinMode, RoutingMode, TimePolicy,
+                    WindFlowError, WinType)
+from .builders import (Filter_Builder, FlatMap_Builder, Map_Builder,
+                       Reduce_Builder, Sink_Builder, Source_Builder)
+from .context import LocalStorage, RuntimeContext
+from .message import Batch, Single
+from .operators.basic_ops import (Filter, FlatMap, Map, Reduce, Shipper, Sink)
+from .operators.source import Source, SourceShipper
+from .topology.multipipe import MultiPipe
+from .topology.pipegraph import PipeGraph
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ExecutionMode", "TimePolicy", "WinType", "RoutingMode", "JoinMode",
+    "WindFlowError",
+    "PipeGraph", "MultiPipe",
+    "Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
+    "SourceShipper", "Shipper",
+    "RuntimeContext", "LocalStorage",
+    "Single", "Batch",
+    "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
+    "Reduce_Builder", "Sink_Builder",
+    "__version__",
+]

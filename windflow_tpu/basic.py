@@ -1,0 +1,111 @@
+"""Core enums, constants and small helpers.
+
+Parity notes (reference = ParaGroup/WindFlow, read-only at /root/reference):
+- Execution modes / time policies / window types / routing modes mirror the
+  enums in ``wf/basic.hpp:78-93``.
+- Watermark cadence knobs mirror ``wf/basic.hpp:199-216`` (default punctuation
+  interval 100 ms).
+- Default channel capacity mirrors FastFlow's ``DEFAULT_BUFFER_CAPACITY``
+  (2048) used for the bounded inter-replica queues.
+
+This module is dependency-free (no jax import) so the pure-CPU plane never
+pays device-plane import cost.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+
+
+class ExecutionMode(enum.Enum):
+    """How out-of-order input is handled (``wf/basic.hpp:78-82``)."""
+
+    DEFAULT = "default"  # watermark-based (Watermark_Collector)
+    DETERMINISTIC = "deterministic"  # total order merge (Ordering_Collector)
+    PROBABILISTIC = "probabilistic"  # K-slack reordering (KSlack_Collector)
+
+
+class TimePolicy(enum.Enum):
+    """Where timestamps come from (``wf/basic.hpp:85-88``)."""
+
+    INGRESS_TIME = "ingress_time"  # assigned by the source shipper at push
+    EVENT_TIME = "event_time"  # provided by the user with the tuple
+
+
+class WinType(enum.Enum):
+    """Window semantics (``wf/basic.hpp:91-93``)."""
+
+    CB = "count_based"
+    TB = "time_based"
+
+
+class RoutingMode(enum.Enum):
+    """Distribution policy of an operator's input (``wf/basic.hpp:232`` area)."""
+
+    NONE = "none"
+    FORWARD = "forward"
+    KEYBY = "keyby"
+    BROADCAST = "broadcast"
+    REBALANCING = "rebalancing"
+
+
+class OpType(enum.Enum):
+    """Coarse operator classification used by topology checks."""
+
+    SOURCE = "source"
+    BASIC = "basic"
+    WIN = "win"
+    JOIN = "join"
+    SINK = "sink"
+    TPU = "tpu"
+    WIN_TPU = "win_tpu"
+
+
+class JoinMode(enum.Enum):
+    """Interval join parallelism (``wf/interval_join.hpp``): KP = key
+    partitioning, DP = data parallelism inside each key."""
+
+    NONE = "none"
+    KP = "key_parallel"
+    DP = "data_parallel"
+
+
+class WinRole(enum.Enum):
+    """Role of a window replica inside composed window operators
+    (``wf/parallel_windows.hpp:120,267``)."""
+
+    SEQ = "seq"
+    PLQ = "plq"
+    WLQ = "wlq"
+    MAP = "map"
+    REDUCE = "reduce"
+
+
+# --- watermark / punctuation cadence (wf/basic.hpp:199-216) -----------------
+DEFAULT_WM_INTERVAL_USEC = 100_000  # punctuation cadence: 100 ms
+DEFAULT_WM_AMOUNT = 64  # check elapsed time once every N emitted tuples
+
+# --- queue capacity (FastFlow DEFAULT_BUFFER_CAPACITY) ----------------------
+DEFAULT_BUFFER_CAPACITY = 2048
+
+# --- device batching --------------------------------------------------------
+DEFAULT_OUTPUT_BATCH_SIZE = 0  # 0 => Single_t-style per-tuple messages
+
+
+def current_time_usecs() -> int:
+    """Microseconds from an arbitrary monotonic origin (reference uses
+    microseconds from epoch; only differences matter)."""
+    return time.monotonic_ns() // 1_000
+
+
+_MISSING = object()
+
+
+def identity(x):
+    return x
+
+
+class WindFlowError(RuntimeError):
+    """Topology / runtime error. The reference prints a colored message and
+    ``exit(EXIT_FAILURE)``; we raise instead so tests can assert on misuse."""

@@ -1,0 +1,153 @@
+"""Fluent builders — the user-facing API.
+
+Parity: ``wf/builders.hpp`` (1,691 LoC of CRTP builders). The reference
+encodes key types in the builder's template parameters (``withKeyBy`` returns
+a new builder type, L217-245); in Python the same validations happen at
+``build()`` time. Accepted functor signatures follow the reference's ``API``
+catalog, with "riched" variants detected by arity (a trailing
+``RuntimeContext`` parameter).
+
+Builder surface (CPU):
+  Source_Builder, Map_Builder, Filter_Builder, FlatMap_Builder,
+  Reduce_Builder, Sink_Builder                                (this module)
+  Keyed/Parallel/Paned/MapReduce/Ffat windows, Interval_Join  (M2+)
+TPU builders (``.with_tpu()``-style siblings of builders_gpu.hpp) live in
+``windflow_tpu.tpu.builders``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .basic import RoutingMode, WindFlowError
+from .operators.basic_ops import Filter, FlatMap, Map, Reduce, Sink
+from .operators.source import Source
+
+
+class BasicBuilder:
+    """withName / withParallelism / withOutputBatchSize / withClosingFunction
+    (``wf/builders.hpp:79-124``)."""
+
+    _default_name = "op"
+
+    def __init__(self, func: Callable) -> None:
+        self._func = func
+        self._name = self._default_name
+        self._parallelism = 1
+        self._output_batch_size = 0
+        self._closing: Optional[Callable] = None
+
+    def with_name(self, name: str) -> "BasicBuilder":
+        self._name = name
+        return self
+
+    def with_parallelism(self, parallelism: int) -> "BasicBuilder":
+        if parallelism < 1:
+            raise WindFlowError("parallelism must be >= 1")
+        self._parallelism = parallelism
+        return self
+
+    def with_output_batch_size(self, size: int) -> "BasicBuilder":
+        if size < 0:
+            raise WindFlowError("output batch size must be >= 0")
+        self._output_batch_size = size
+        return self
+
+    def with_closing_function(self, fn: Callable) -> "BasicBuilder":
+        self._closing = fn
+        return self
+
+    def _finish(self, op):
+        op.closing_func = self._closing
+        return op
+
+
+class _RoutableBuilder(BasicBuilder):
+    """Adds withKeyBy / withRebalancing (``wf/builders.hpp:217-245``)."""
+
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._routing = RoutingMode.FORWARD
+        self._key_extractor: Optional[Callable] = None
+
+    def with_key_by(self, key_extractor: Callable[[Any], Any]) -> "_RoutableBuilder":
+        self._routing = RoutingMode.KEYBY
+        self._key_extractor = key_extractor
+        return self
+
+    def with_rebalancing(self) -> "_RoutableBuilder":
+        if self._routing is RoutingMode.KEYBY:
+            raise WindFlowError("withRebalancing is incompatible with withKeyBy")
+        self._routing = RoutingMode.REBALANCING
+        return self
+
+    def with_broadcast(self) -> "_RoutableBuilder":
+        if self._routing is RoutingMode.KEYBY:
+            raise WindFlowError("withBroadcast is incompatible with withKeyBy")
+        self._routing = RoutingMode.BROADCAST
+        return self
+
+
+class Source_Builder(BasicBuilder):
+    _default_name = "source"
+
+    def build(self) -> Source:
+        return self._finish(Source(self._func, self._name, self._parallelism,
+                                   self._output_batch_size))
+
+
+class Map_Builder(_RoutableBuilder):
+    _default_name = "map"
+
+    def build(self) -> Map:
+        return self._finish(Map(self._func, self._name, self._parallelism,
+                                self._routing, self._key_extractor,
+                                self._output_batch_size))
+
+
+class Filter_Builder(_RoutableBuilder):
+    _default_name = "filter"
+
+    def build(self) -> Filter:
+        return self._finish(Filter(self._func, self._name, self._parallelism,
+                                   self._routing, self._key_extractor,
+                                   self._output_batch_size))
+
+
+class FlatMap_Builder(_RoutableBuilder):
+    _default_name = "flatmap"
+
+    def build(self) -> FlatMap:
+        return self._finish(FlatMap(self._func, self._name, self._parallelism,
+                                    self._routing, self._key_extractor,
+                                    self._output_batch_size))
+
+
+class Reduce_Builder(_RoutableBuilder):
+    """``withKeyBy`` is mandatory; ``withInitialState`` mirrors
+    ``wf/builders.hpp:627``."""
+
+    _default_name = "reduce"
+
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._initial_state: Any = None
+
+    def with_initial_state(self, state: Any) -> "Reduce_Builder":
+        self._initial_state = state
+        return self
+
+    def build(self) -> Reduce:
+        if self._key_extractor is None:
+            raise WindFlowError("Reduce_Builder: withKeyBy(...) is mandatory")
+        return self._finish(Reduce(self._func, self._key_extractor,
+                                   self._initial_state, self._name,
+                                   self._parallelism, self._output_batch_size))
+
+
+class Sink_Builder(_RoutableBuilder):
+    _default_name = "sink"
+
+    def build(self) -> Sink:
+        return self._finish(Sink(self._func, self._name, self._parallelism,
+                                 self._routing, self._key_extractor))

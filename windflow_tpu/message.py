@@ -1,0 +1,116 @@
+"""Stream messages: Single (one tuple) and Batch (micro-batch of tuples).
+
+Parity notes:
+- ``Single`` mirrors ``wf/single_t.hpp:50-197``: payload + id + timestamp +
+  watermark + punctuation flag. The reference keeps one watermark *per
+  destination* inside a shared, refcounted message; in Python we instead copy
+  the (tiny) message per destination on multicast, so a scalar watermark
+  suffices and no atomic delete_counter is needed.
+- ``Batch`` mirrors ``wf/batch_cpu_t.hpp:51-221``: a row-list of
+  ``(payload, ts)`` whose watermark is the min over constituents
+  (``batch_cpu_t.hpp:184-186``).
+- ``stream_tag`` distinguishes the A/B inputs of Interval_Join (the reference
+  tags by FastFlow channel id vs. a separator id,
+  ``wf/watermark_collector.hpp:121-134``).
+
+Device batches live in ``windflow_tpu.tpu.batch`` (columnar, HBM-resident);
+they share the same metadata protocol (watermark / punct / stream_tag / size).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+
+class StreamMsg:
+    """Common metadata protocol for everything traveling on a channel."""
+
+    __slots__ = ()
+
+    is_punct = False
+
+    def min_watermark(self) -> int:
+        raise NotImplementedError
+
+
+class Single(StreamMsg):
+    __slots__ = ("payload", "id", "ts", "wm", "is_punct", "stream_tag")
+
+    def __init__(self, payload: Any, id: int = 0, ts: int = 0, wm: int = 0,
+                 is_punct: bool = False, stream_tag: int = 0) -> None:
+        self.payload = payload
+        self.id = id
+        self.ts = ts
+        self.wm = wm
+        self.is_punct = is_punct
+        self.stream_tag = stream_tag
+
+    def min_watermark(self) -> int:
+        return self.wm
+
+    def copy_for_dest(self) -> "Single":
+        return Single(self.payload, self.id, self.ts, self.wm,
+                      self.is_punct, self.stream_tag)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        if self.is_punct:
+            return f"<Punct wm={self.wm}>"
+        return f"<Single {self.payload!r} id={self.id} ts={self.ts} wm={self.wm}>"
+
+
+def make_punctuation(wm: int, stream_tag: int = 0) -> Single:
+    """Watermark punctuation: no payload, only a watermark
+    (``wf/keyby_emitter.hpp:305-376``)."""
+    return Single(None, 0, 0, wm, True, stream_tag)
+
+
+class Batch(StreamMsg):
+    """Row-major CPU micro-batch. ``rows`` is a list of ``(payload, ts)``."""
+
+    __slots__ = ("rows", "wm", "is_punct", "stream_tag", "id")
+
+    def __init__(self, rows: Optional[List[Tuple[Any, int]]] = None,
+                 wm: int = 0, is_punct: bool = False, stream_tag: int = 0) -> None:
+        self.rows = rows if rows is not None else []
+        self.wm = wm
+        self.is_punct = is_punct
+        self.stream_tag = stream_tag
+        self.id = 0  # per-channel sequence number (DETERMINISTIC ordering)
+
+    # -- construction ------------------------------------------------------
+    def add_tuple(self, payload: Any, ts: int, wm: int) -> None:
+        """Append a tuple; batch watermark = min over constituents
+        (``wf/batch_cpu_t.hpp:184-186``)."""
+        if not self.rows or wm < self.wm:
+            self.wm = wm
+        self.rows.append((payload, ts))
+
+    # -- protocol ----------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    @property
+    def size(self) -> int:
+        return len(self.rows)
+
+    def min_watermark(self) -> int:
+        return self.wm
+
+    def copy_for_dest(self) -> "Batch":
+        return Batch(list(self.rows), self.wm, self.is_punct, self.stream_tag)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<Batch n={len(self.rows)} wm={self.wm}>"
+
+
+class EOS:
+    """End-of-stream sentinel (FastFlow EOS equivalent). One is sent per
+    producer->consumer edge so consumers can count per-channel completion."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<EOS>"
+
+
+EOS_SENTINEL = EOS()

@@ -1,0 +1,3 @@
+from .stats import StatsRecord
+
+__all__ = ["StatsRecord"]

@@ -1,0 +1,84 @@
+"""Per-replica statistics (reference ``wf/stats_record.hpp:49-160``).
+
+Counters: inputs/outputs received/sent, ignored (dropped) tuples, service time
+EWMA (``wf/basic_operator.hpp:144-158``), and device-plane traffic (batches
+staged to/from the TPU, bytes moved — the analog of the reference's kernels
+launched / bytes H2D/D2H). Serialized to JSON by the PipeGraph at wait_end
+(``wf/pipegraph.hpp:464-522``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+_EWMA_ALPHA = 0.1
+
+
+class StatsRecord:
+    __slots__ = (
+        "op_name", "replica_idx", "start_time",
+        "inputs_received", "bytes_received", "outputs_sent", "bytes_sent",
+        "inputs_ignored", "punct_received", "punct_sent",
+        "service_time_us", "eff_service_time_us",
+        "device_batches_in", "device_batches_out",
+        "device_bytes_h2d", "device_bytes_d2h", "device_programs_run",
+        "is_terminated", "_last_svc_start",
+    )
+
+    def __init__(self, op_name: str = "", replica_idx: int = 0) -> None:
+        self.op_name = op_name
+        self.replica_idx = replica_idx
+        self.start_time = time.monotonic()
+        self.inputs_received = 0
+        self.bytes_received = 0
+        self.outputs_sent = 0
+        self.bytes_sent = 0
+        self.inputs_ignored = 0
+        self.punct_received = 0
+        self.punct_sent = 0
+        self.service_time_us = 0.0  # EWMA over svc() durations
+        self.eff_service_time_us = 0.0
+        self.device_batches_in = 0
+        self.device_batches_out = 0
+        self.device_bytes_h2d = 0
+        self.device_bytes_d2h = 0
+        self.device_programs_run = 0
+        self.is_terminated = False
+        self._last_svc_start = 0.0
+
+    # -- service-time recording (wf/basic_operator.hpp:134-158) -------------
+    def start_svc(self) -> None:
+        self._last_svc_start = time.perf_counter()
+
+    def end_svc(self, n_tuples: int = 1) -> None:
+        dt_us = (time.perf_counter() - self._last_svc_start) * 1e6
+        per_tuple = dt_us / max(1, n_tuples)
+        if self.service_time_us == 0.0:
+            self.service_time_us = per_tuple
+        else:
+            self.service_time_us += _EWMA_ALPHA * (per_tuple - self.service_time_us)
+        self.eff_service_time_us = self.service_time_us
+
+    def to_dict(self) -> Dict[str, Any]:
+        elapsed = max(time.monotonic() - self.start_time, 1e-9)
+        return {
+            "Operator_name": self.op_name,
+            "Replica_id": self.replica_idx,
+            "Inputs_received": self.inputs_received,
+            "Bytes_received": self.bytes_received,
+            "Outputs_sent": self.outputs_sent,
+            "Bytes_sent": self.bytes_sent,
+            "Inputs_ignored": self.inputs_ignored,
+            "Punctuations_received": self.punct_received,
+            "Punctuations_sent": self.punct_sent,
+            "Service_time_usec": round(self.service_time_us, 3),
+            "Eff_Service_time_usec": round(self.eff_service_time_us, 3),
+            "Throughput_tuples_sec": round(self.inputs_received / elapsed, 1),
+            "Device_batches_in": self.device_batches_in,
+            "Device_batches_out": self.device_batches_out,
+            "Device_bytes_H2D": self.device_bytes_h2d,
+            "Device_bytes_D2H": self.device_bytes_d2h,
+            "Device_programs_run": self.device_programs_run,
+            "isTerminated": self.is_terminated,
+        }

@@ -1,0 +1,87 @@
+"""Source operator and Source_Shipper.
+
+Parity: ``wf/source.hpp:55-163`` (user functor drives the shipper, then EOS)
+and ``wf/source_shipper.hpp`` (``push`` for INGRESS_TIME at L171/210,
+``pushWithTimestamp``/``setNextWatermark`` for EVENT_TIME at L248/289/328).
+Timestamps are microseconds; in DEFAULT mode with ingress time the watermark
+equals the tuple timestamp (monotone because "now" is monotone).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..basic import (ExecutionMode, OpType, RoutingMode, TimePolicy,
+                     WindFlowError, current_time_usecs)
+from .base import BasicOperator, BasicReplica, arity
+
+
+class SourceShipper:
+    """User-visible push API for Source functors."""
+
+    def __init__(self, replica: "SourceReplica") -> None:
+        self._r = replica
+        self._next_wm = 0
+        self._epoch = current_time_usecs()
+
+    # -- INGRESS_TIME ------------------------------------------------------
+    def push(self, payload: Any) -> None:
+        if self._r.op.time_policy is not TimePolicy.INGRESS_TIME:
+            raise WindFlowError("push() requires INGRESS_TIME; use "
+                                "push_with_timestamp() under EVENT_TIME")
+        ts = current_time_usecs() - self._epoch
+        wm = ts if self._r.op.execution_mode is ExecutionMode.DEFAULT else 0
+        self._r.ship(payload, ts, wm)
+
+    # -- EVENT_TIME --------------------------------------------------------
+    def push_with_timestamp(self, payload: Any, ts: int) -> None:
+        if self._r.op.time_policy is not TimePolicy.EVENT_TIME:
+            raise WindFlowError("push_with_timestamp() requires EVENT_TIME")
+        self._r.ship(payload, int(ts), self._next_wm)
+
+    def set_next_watermark(self, wm: int) -> None:
+        if wm < self._next_wm:
+            raise WindFlowError("watermarks must be non-decreasing")
+        self._next_wm = int(wm)
+
+    # convenience used by generators/tests
+    @property
+    def current_watermark(self) -> int:
+        return self._next_wm
+
+
+class Source(BasicOperator):
+    """Parallel replicas are independent generators; ``func(shipper[, ctx])``
+    is called once per replica and runs its own loop."""
+
+    op_type = OpType.SOURCE
+
+    def __init__(self, func: Callable, name: str = "source",
+                 parallelism: int = 1, output_batch_size: int = 0) -> None:
+        super().__init__(name, parallelism, RoutingMode.NONE,
+                         output_batch_size=output_batch_size)
+        self.func = func
+        self._riched = arity(func) >= 2
+
+    def build_replicas(self) -> None:
+        self.replicas = [SourceReplica(self, i) for i in range(self.parallelism)]
+
+
+class SourceReplica(BasicReplica):
+    def process(self, payload, ts, wm, tag):  # pragma: no cover
+        raise WindFlowError("Source has no input")
+
+    def run_source(self) -> None:
+        """Run the user generation loop to completion (then the worker
+        triggers the EOS cascade, ``wf/source.hpp:114-129``)."""
+        shipper = SourceShipper(self)
+        if self.op._riched:
+            self.op.func(shipper, self.context)
+        else:
+            self.op.func(shipper)
+
+    def ship(self, payload: Any, ts: int, wm: int) -> None:
+        if wm > self.cur_wm:
+            self.cur_wm = wm
+        self.stats.inputs_received += 1
+        self.emitter.emit(payload, ts, self.cur_wm)

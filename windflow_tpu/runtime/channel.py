@@ -1,0 +1,119 @@
+"""Bounded channels and ports connecting replicas.
+
+This is the FastFlow replacement (reference L0). WindFlow replicas are
+FastFlow nodes joined by lock-free SPSC queues with pinned threads
+(``SURVEY.md`` L0); here every consumer worker owns one bounded MPSC
+``Channel`` that merges all of its input edges (like ``ff_minode``), and each
+producer edge is a ``QueuePort`` stamping the consumer-side channel index
+(``ff::ff_minode::get_channel_id`` equivalent). Chained (fused) stages talk
+through ``InlinePort`` — a plain function call, the analog of FastFlow's
+``combine_with_laststage`` thread fusion (``wf/multipipe.hpp:576-582``).
+
+A native C++ SPSC ring (windflow_tpu/native) can replace the stdlib deque
+backing transparently; the Python fallback keeps zero hard dependencies.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, List, Optional, Tuple
+
+from ..basic import DEFAULT_BUFFER_CAPACITY
+from ..message import EOS_SENTINEL
+
+
+class Channel:
+    """Bounded blocking MPSC queue of ``(channel_idx, msg)`` pairs.
+
+    Bounded => backpressure, like FastFlow's FF_BOUNDED_BUFFER mode.
+    """
+
+    __slots__ = ("_q", "_lock", "_not_empty", "_not_full", "capacity", "n_inputs")
+
+    def __init__(self, capacity: int = DEFAULT_BUFFER_CAPACITY) -> None:
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self.capacity = capacity
+        self.n_inputs = 0  # number of producer edges; assigned at wiring
+
+    def register_input(self) -> int:
+        """Returns the channel index assigned to a new producer edge."""
+        idx = self.n_inputs
+        self.n_inputs += 1
+        return idx
+
+    def put(self, ch_idx: int, msg: Any) -> None:
+        with self._not_full:
+            while len(self._q) >= self.capacity:
+                self._not_full.wait()
+            self._q.append((ch_idx, msg))
+            self._not_empty.notify()
+
+    def get(self) -> Tuple[int, Any]:
+        with self._not_empty:
+            while not self._q:
+                self._not_empty.wait()
+            item = self._q.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_nowait(self) -> Optional[Tuple[int, Any]]:
+        with self._lock:
+            if not self._q:
+                return None
+            item = self._q.popleft()
+            self._not_full.notify()
+            return item
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+class Port:
+    """Destination of an emitter edge."""
+
+    __slots__ = ()
+
+    def send(self, msg: Any) -> None:
+        raise NotImplementedError
+
+    def send_eos(self) -> None:
+        raise NotImplementedError
+
+
+class QueuePort(Port):
+    """Edge to a replica running in another thread."""
+
+    __slots__ = ("channel", "ch_idx")
+
+    def __init__(self, channel: Channel) -> None:
+        self.channel = channel
+        self.ch_idx = channel.register_input()
+
+    def send(self, msg: Any) -> None:
+        self.channel.put(self.ch_idx, msg)
+
+    def send_eos(self) -> None:
+        self.channel.put(self.ch_idx, EOS_SENTINEL)
+
+
+class InlinePort(Port):
+    """Edge to a replica fused in the same thread (chaining). ``send`` is a
+    synchronous call into the downstream replica's message handler."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node: Any) -> None:
+        self.node = node  # object with handle_msg(ch, msg); single channel 0
+
+    def send(self, msg: Any) -> None:
+        self.node.handle_msg(0, msg)
+
+    def send_eos(self) -> None:
+        # EOS through a chain is driven by the worker's termination cascade
+        # (Worker.run), not by in-band sentinels.
+        pass

@@ -1,0 +1,209 @@
+"""Collectors: the routing plane on the consumer side.
+
+One collector is fused in front of the first replica of a stage (the
+reference fuses a FastFlow node with ``combine_with_firststage``,
+``wf/multipipe.hpp:200-244``); here it is simply the head of the worker's
+chain, invoked in the same thread.
+
+- ``WatermarkCollector`` (DEFAULT mode): per-input-channel max watermark;
+  outgoing watermark = min over still-open channels
+  (``wf/watermark_collector.hpp:65-80``). Optionally tags join streams A/B by
+  channel id vs. a separator (``watermark_collector.hpp:121-134``).
+- ``OrderingCollector`` (DETERMINISTIC mode): k-way merge of per-channel
+  ordered streams into a total order by (ts, id)
+  (``wf/ordering_collector.hpp:50-272``).
+- ``KSlackCollector`` (PROBABILISTIC mode): K-slack buffering with adaptive
+  K = max observed delay; late tuples are dropped and counted
+  (``wf/kslack_collector.hpp:52-243``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+from ..message import Batch, Single
+
+MAX_WM = (1 << 63) - 1
+
+
+class AtomicCounter:
+    """Shared dropped-tuple counter (``wf/pipegraph.hpp:91-92``)."""
+
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._v
+
+
+class BasicCollector:
+    """Chain-node protocol: handle_msg(ch, msg) / on_channel_eos(ch) /
+    terminate(). ``next_node`` is the stage's first replica."""
+
+    def __init__(self, n_channels: int, next_node: Any,
+                 separator_id: Optional[int] = None) -> None:
+        self.n_channels = n_channels
+        self.next_node = next_node
+        self.separator_id = separator_id  # join A/B channel split point
+        self.live = set(range(n_channels))
+
+    def _tag(self, ch: int, msg: Any) -> None:
+        if self.separator_id is not None:
+            msg.stream_tag = 0 if ch < self.separator_id else 1
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        raise NotImplementedError
+
+    def on_channel_eos(self, ch: int) -> None:
+        self.live.discard(ch)
+
+    def terminate(self) -> None:
+        pass
+
+
+class WatermarkCollector(BasicCollector):
+    def __init__(self, n_channels: int, next_node: Any,
+                 separator_id: Optional[int] = None) -> None:
+        super().__init__(n_channels, next_node, separator_id)
+        self._ch_wm = [0] * n_channels
+
+    def _out_wm(self) -> int:
+        if not self.live:
+            return max(self._ch_wm) if self._ch_wm else 0
+        return min(self._ch_wm[c] for c in self.live)
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        wm = msg.min_watermark()
+        if wm > self._ch_wm[ch]:
+            self._ch_wm[ch] = wm
+        self._tag(ch, msg)
+        msg.wm = self._out_wm()
+        self.next_node.handle_msg(0, msg)
+
+
+class OrderingCollector(BasicCollector):
+    """Each input channel is locally ordered (per-destination ids are
+    assigned monotonically by emitters); merge to a total order. A message is
+    releasable once every live channel has something buffered (its head is a
+    lower bound for anything that channel will send)."""
+
+    def __init__(self, n_channels: int, next_node: Any,
+                 separator_id: Optional[int] = None,
+                 by_timestamp: bool = True) -> None:
+        super().__init__(n_channels, next_node, separator_id)
+        self.by_timestamp = by_timestamp
+        self._bufs: List[deque] = [deque() for _ in range(n_channels)]
+
+    def _key(self, msg: Any):
+        if isinstance(msg, Batch):
+            ts = msg.rows[0][1] if msg.rows else 0
+        else:
+            ts = msg.ts
+        return (ts, msg.id) if self.by_timestamp else (msg.id, ts)
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        if msg.is_punct:  # no punctuations in DETERMINISTIC mode; absorb
+            return
+        self._tag(ch, msg)
+        self._bufs[ch].append(msg)
+        self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            best_ch = -1
+            best_key = None
+            for c in self.live:
+                if not self._bufs[c]:
+                    return  # an open channel is empty: cannot release yet
+                k = self._key(self._bufs[c][0])
+                if best_key is None or k < best_key:
+                    best_key, best_ch = k, c
+            for c in range(self.n_channels):  # closed channels may hold leftovers
+                if c not in self.live and self._bufs[c]:
+                    k = self._key(self._bufs[c][0])
+                    if best_key is None or k < best_key:
+                        best_key, best_ch = k, c
+            if best_ch < 0:
+                return
+            self.next_node.handle_msg(0, self._bufs[best_ch].popleft())
+
+    def on_channel_eos(self, ch: int) -> None:
+        super().on_channel_eos(ch)
+        self._drain()
+
+    def terminate(self) -> None:
+        # all channels closed: total merge of leftovers
+        heap = []
+        for c, buf in enumerate(self._bufs):
+            for i, m in enumerate(buf):
+                heapq.heappush(heap, (self._key(m), c, i, m))
+            buf_len = len(buf)
+        while heap:
+            _, _, _, m = heapq.heappop(heap)
+            self.next_node.handle_msg(0, m)
+        self._bufs = [deque() for _ in range(self.n_channels)]
+
+
+class KSlackCollector(BasicCollector):
+    """Adaptive K-slack (``wf/kslack_collector.hpp:99-118``): K tracks the
+    maximum observed disorder ``max_ts - ts``; buffered tuples are released in
+    timestamp order once ``ts <= max_ts - K``. Tuples older than the released
+    frontier are dropped and counted."""
+
+    def __init__(self, n_channels: int, next_node: Any,
+                 dropped_counter: Optional[AtomicCounter] = None,
+                 separator_id: Optional[int] = None) -> None:
+        super().__init__(n_channels, next_node, separator_id)
+        self.K = 0
+        self._max_ts = 0
+        self._frontier = -1  # max ts already released
+        self._heap: list = []  # (ts, seq, msg)
+        self._seq = 0
+        self.dropped = dropped_counter if dropped_counter is not None else AtomicCounter()
+
+    @staticmethod
+    def _ts_of(msg: Any) -> int:
+        if isinstance(msg, Batch):
+            return msg.rows[0][1] if msg.rows else 0
+        return msg.ts
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        if msg.is_punct:
+            return
+        self._tag(ch, msg)
+        ts = self._ts_of(msg)
+        # adapt K from EVERY arrival (including late ones we then drop) —
+        # otherwise K never learns the stream's disorder and the frontier
+        # drops everything behind it
+        if ts > self._max_ts:
+            self._max_ts = ts
+        delay = self._max_ts - ts
+        if delay > self.K:
+            self.K = delay
+        if ts <= self._frontier:
+            n = msg.size if isinstance(msg, Batch) else 1
+            self.dropped.add(n)
+            return
+        heapq.heappush(self._heap, (ts, self._seq, msg))
+        self._seq += 1
+        self._release(self._max_ts - self.K)
+
+    def _release(self, up_to: int) -> None:
+        while self._heap and self._heap[0][0] <= up_to:
+            ts, _, m = heapq.heappop(self._heap)
+            if ts > self._frontier:
+                self._frontier = ts
+            self.next_node.handle_msg(0, m)
+
+    def terminate(self) -> None:
+        self._release(MAX_WM)

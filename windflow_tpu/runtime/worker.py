@@ -1,0 +1,102 @@
+"""Worker threads: one OS thread per replica-chain.
+
+Parity: FastFlow spawns one pinned thread per node
+(``wf/pipegraph.hpp:610-764`` run path); chained operators share a thread
+(``wf/multipipe.hpp:569-585``), and the stage collector is fused in front of
+the first replica. Termination mirrors the reference's EOS cascade: sources
+finish their loop, EOS flows per-edge, each replica flushes windows/partial
+batches on the way down (``wf/basic_operator.hpp:180-189``).
+
+Error handling is stricter than the reference (which prints and
+``exit(EXIT_FAILURE)``): a replica that throws records the error, drains its
+inputs, and force-propagates EOS downstream so the whole graph unwinds and
+``PipeGraph.wait_end`` can re-raise in the caller's thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from ..message import EOS
+from .channel import Channel
+
+
+class Worker(threading.Thread):
+    """Runs a chain ``[collector?] + [replica_op1, replica_op2, ...]``.
+
+    For source stages ``channel`` is None and the first chain node must be a
+    SourceReplica (drives its own generation loop).
+    """
+
+    def __init__(self, wname: str, chain: List[Any],
+                 channel: Optional[Channel] = None) -> None:
+        super().__init__(name=wname, daemon=True)
+        self.chain = chain
+        self.channel = channel
+        self.error: Optional[BaseException] = None
+        self._eos_seen = 0
+
+    def run(self) -> None:
+        try:
+            self._process()
+            self._shutdown()
+        except BaseException as e:
+            self.error = e
+            # unwind so sibling workers never block on us: swallow the rest
+            # of our input, then force EOS downstream
+            try:
+                self._drain_inputs()
+            except BaseException:
+                pass
+            try:
+                self._emergency_eos()
+            except BaseException:
+                pass
+
+    # -- normal path -------------------------------------------------------
+    def _process(self) -> None:
+        head = self.chain[0]
+        if self.channel is None:
+            head.run_source()
+            return
+        n_inputs = self.channel.n_inputs
+        has_coll = hasattr(head, "on_channel_eos")
+        while self._eos_seen < n_inputs:
+            ch, msg = self.channel.get()
+            if isinstance(msg, EOS):
+                self._eos_seen += 1
+                if has_coll:
+                    head.on_channel_eos(ch)
+                continue
+            head.handle_msg(ch, msg)
+
+    def _shutdown(self) -> None:
+        # EOS cascade: terminate in chain order so that anything emitted by
+        # an upstream node's flush is processed by the downstream fused nodes
+        # before they flush themselves.
+        for node in self.chain:
+            node.terminate()
+        last = self.chain[-1]
+        if getattr(last, "emitter", None) is not None:
+            last.emitter.send_eos_all()
+
+    # -- error path --------------------------------------------------------
+    def _drain_inputs(self) -> None:
+        if self.channel is None:
+            return
+        n_inputs = self.channel.n_inputs
+        while self._eos_seen < n_inputs:
+            _, msg = self.channel.get()
+            if isinstance(msg, EOS):
+                self._eos_seen += 1
+
+    def _emergency_eos(self) -> None:
+        last = self.chain[-1]
+        em = getattr(last, "emitter", None)
+        if em is not None:
+            for port in em.eos_ports():
+                try:
+                    port.send_eos()
+                except BaseException:
+                    pass

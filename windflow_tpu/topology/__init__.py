@@ -1,0 +1,4 @@
+from .pipegraph import PipeGraph
+from .multipipe import MultiPipe
+
+__all__ = ["PipeGraph", "MultiPipe"]

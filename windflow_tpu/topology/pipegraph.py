@@ -1,0 +1,298 @@
+"""PipeGraph: the streaming environment — build, wire, run, wait.
+
+Parity with ``wf/pipegraph.hpp``:
+- ``PipeGraph(name, ExecutionMode, TimePolicy)`` (L545-554);
+- ``add_source`` (L593) returns the root MultiPipe;
+- ``run`` = ``start`` + ``wait_end`` (L610-764);
+- dropped-tuple accounting (L782-785), per-operator stats dump (L464-522),
+  dot diagram generation (Graphviz, L525-534).
+
+Wiring rules are described in ``topology/stage.py``; emitter/collector
+selection mirrors ``wf/multipipe.hpp:200-362``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
+                     RoutingMode, TimePolicy, WindFlowError)
+from ..operators.base import BasicOperator
+from ..runtime.channel import Channel, InlinePort, QueuePort
+from ..runtime.collectors import (AtomicCounter, KSlackCollector,
+                                  OrderingCollector, WatermarkCollector)
+from ..runtime.emitters import (BasicEmitter, BroadcastEmitter, ForwardEmitter,
+                                KeyByEmitter, NullEmitter, SplittingEmitter)
+from ..runtime.worker import Worker
+from .multipipe import MultiPipe
+from .stage import Stage
+
+
+class PipeGraph:
+    def __init__(self, name: str = "pipegraph",
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 time_policy: TimePolicy = TimePolicy.INGRESS_TIME,
+                 channel_capacity: int = DEFAULT_BUFFER_CAPACITY) -> None:
+        self.name = name
+        self.execution_mode = execution_mode
+        self.time_policy = time_policy
+        self.channel_capacity = channel_capacity
+        self._stages: List[Stage] = []
+        self._source_pipes: List[MultiPipe] = []
+        self._ops: List[BasicOperator] = []
+        self._workers: List[Worker] = []
+        self.dropped = AtomicCounter()
+        self._built = False
+        self._started = False
+        self._ended = False
+
+    # ------------------------------------------------------------------
+    def _register_op(self, op: BasicOperator) -> None:
+        self._ops.append(op)
+
+    def add_source(self, source_op: BasicOperator) -> MultiPipe:
+        if self._started:
+            raise WindFlowError("cannot add sources after start()")
+        if source_op.op_type != OpType.SOURCE:
+            raise WindFlowError("add_source requires a Source-kind operator")
+        mp = MultiPipe(self)
+        mp._claim(source_op)
+        stage = Stage(source_op)
+        self._stages.append(stage)
+        mp.tail_groups = [[stage]]
+        self._source_pipes.append(mp)
+        return mp
+
+    # ------------------------------------------------------------------
+    # build & wiring
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        if self._built:
+            return
+        self._built = True
+        for s in self._stages:
+            for op in s.ops:
+                op.configure(self.execution_mode, self.time_policy)
+                op.build_replicas()
+        # channels (one per consumer replica)
+        for s in self._stages:
+            if not s.is_source:
+                s.channels = [Channel(self.channel_capacity)
+                              for _ in range(s.parallelism)]
+        # intra-stage chain wiring (fused InlinePort edges)
+        for s in self._stages:
+            for a, b in zip(s.ops[:-1], s.ops[1:]):
+                for i in range(s.parallelism):
+                    em = ForwardEmitter(1, 0, self.execution_mode)
+                    em.punct_generation = False
+                    em.set_ports([InlinePort(b.replicas[i])])
+                    a.replicas[i].set_emitter(em)
+        # inter-stage wiring, consumer-driven so that input channel indices
+        # follow upstream order (join stream A channels first)
+        for c in self._stages:
+            for edge in c.upstreams:
+                self._wire_edge(edge.stage, edge.branch, c)
+        # terminal emitters
+        for s in self._stages:
+            last = s.last_op
+            for r in last.replicas:
+                if r.emitter is None:
+                    r.set_emitter(NullEmitter())
+        # split stages: assemble per-replica splitting emitters
+        for s in self._stages:
+            if s.is_split:
+                for i, r in enumerate(s.last_op.replicas):
+                    inner = r._split_inner  # branch -> emitter
+                    ems = [inner.get(b) for b in range(len(s.split_branches))]
+                    missing = [b for b, e in enumerate(ems) if e is None]
+                    if missing:
+                        raise WindFlowError(
+                            f"split stage {s.describe()}: branches {missing} "
+                            f"have no operators")
+                    se = SplittingEmitter(s.split_logic, ems, self.execution_mode)
+                    r.set_emitter(se)
+        # collectors + workers
+        for s in self._stages:
+            self._make_workers(s)
+
+    def _edge_emitter_kind(self, producer: Stage, consumer: Stage):
+        first = consumer.first_op
+        routing = first.input_routing
+        obs = producer.last_op.output_batch_size
+        return routing, obs
+
+    def _wire_edge(self, producer: Stage, branch: Optional[int],
+                   consumer: Stage) -> None:
+        """Create one emitter per producer replica targeting all consumer
+        replicas (or one-to-one for same-parallelism FORWARD, reference
+        Case 2)."""
+        first = consumer.first_op
+        routing = first.input_routing
+        obs = producer.last_op.output_batch_size
+        n_dests = consumer.parallelism
+        one_to_one = (routing is RoutingMode.FORWARD
+                      and branch is None
+                      and producer.parallelism == n_dests)
+        if routing is RoutingMode.BROADCAST:
+            for op in consumer.ops:
+                for r in op.replicas:
+                    r.copy_on_write = True
+        for pi, pr in enumerate(producer.last_op.replicas):
+            if routing is RoutingMode.KEYBY:
+                em: BasicEmitter = KeyByEmitter(first.key_extractor, n_dests,
+                                                obs, self.execution_mode)
+            elif routing is RoutingMode.BROADCAST:
+                em = BroadcastEmitter(n_dests, obs, self.execution_mode)
+            elif one_to_one:
+                em = ForwardEmitter(1, obs, self.execution_mode)
+            else:  # FORWARD shuffle / REBALANCING
+                em = ForwardEmitter(n_dests, obs, self.execution_mode)
+            if one_to_one:
+                ports = [QueuePort(consumer.channels[pi])]
+            else:
+                ports = [QueuePort(ch) for ch in consumer.channels]
+            em.set_ports(ports)
+            if branch is None:
+                pr.set_emitter(em)
+            else:
+                if not hasattr(pr, "_split_inner"):
+                    pr._split_inner = {}
+                pr._split_inner[branch] = em
+                em.stats = pr.stats
+
+    def _make_collector(self, stage: Stage, replica_idx: int):
+        first_replica = stage.first_op.replicas[replica_idx]
+        n_in = stage.channels[replica_idx].n_inputs
+        separator = None
+        if stage.first_op.op_type == OpType.JOIN:
+            a_stages = getattr(stage, "join_a_stages", [])
+            separator = sum(s.parallelism for s in a_stages)
+        mode = self.execution_mode
+        if mode is ExecutionMode.DEFAULT:
+            if n_in > 1 or separator is not None:
+                return WatermarkCollector(n_in, first_replica, separator)
+            return None
+        if mode is ExecutionMode.DETERMINISTIC:
+            if n_in > 1 or separator is not None:
+                return OrderingCollector(n_in, first_replica, separator,
+                                         by_timestamp=True)
+            return None
+        # PROBABILISTIC: always reorder (disorder exists within one channel)
+        return KSlackCollector(n_in, first_replica, self.dropped, separator)
+
+    def _make_workers(self, stage: Stage) -> None:
+        p = stage.parallelism
+        for i in range(p):
+            chain: List[Any] = []
+            channel = None
+            if not stage.is_source:
+                channel = stage.channels[i]
+                coll = self._make_collector(stage, i)
+                if coll is not None:
+                    chain.append(coll)
+            chain.extend(op.replicas[i] for op in stage.ops)
+            w = Worker(f"{self.name}/{stage.describe()}[{i}]", chain, channel)
+            stage.workers.append(w)
+            self._workers.append(w)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            raise WindFlowError("PipeGraph already started")
+        self._validate()
+        self._build()
+        self._started = True
+        self._t0 = time.monotonic()
+        for w in self._workers:
+            w.start()
+
+    def wait_end(self) -> None:
+        if not self._started:
+            raise WindFlowError("PipeGraph not started")
+        if self._ended:
+            return
+        for w in self._workers:
+            w.join()
+        self._ended = True
+        self.elapsed_sec = time.monotonic() - self._t0
+        errors = [w.error for w in self._workers if w.error is not None]
+        if errors:
+            raise errors[0]
+        if os.environ.get("WF_TRACING_ENABLED"):
+            self.dump_stats(os.environ.get("WF_LOG_DIR", "log"))
+
+    def run(self) -> None:
+        """Blocking run (reference ``PipeGraph::run``, L610)."""
+        self.start()
+        self.wait_end()
+
+    def _validate(self) -> None:
+        if not self._stages:
+            raise WindFlowError("empty PipeGraph: no sources")
+        for s in self._stages:
+            if s.is_split:
+                missing = [b for b, st in enumerate(s.split_branches)
+                           if st is None]
+                if missing:
+                    raise WindFlowError(
+                        f"split after {s.describe()}: empty branches {missing}")
+            elif s.downstream is None and not s.is_sink:
+                raise WindFlowError(
+                    f"stage {s.describe()} has no sink downstream")
+
+    # ------------------------------------------------------------------
+    # introspection (reference: getNumThreads, getNumDroppedTuples, stats)
+    # ------------------------------------------------------------------
+    def get_num_threads(self) -> int:
+        self._build()
+        return len(self._workers)
+
+    def get_num_dropped_tuples(self) -> int:
+        return self.dropped.value
+
+    def get_stats(self) -> Dict[str, Any]:
+        ops = []
+        for op in self._ops:
+            ops.append({
+                "name": op.name,
+                "kind": type(op).__name__,
+                "parallelism": op.parallelism,
+                "replicas": [r.stats.to_dict() for r in op.replicas],
+            })
+        return {
+            "PipeGraph_name": self.name,
+            "Mode": self.execution_mode.name,
+            "Time_policy": self.time_policy.name,
+            "Threads": len(self._workers),
+            "Dropped_tuples": self.dropped.value,
+            "Operators": ops,
+        }
+
+    def dump_stats(self, log_dir: str = "log") -> str:
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, f"{self.name}_stats.json")
+        with open(path, "w") as f:
+            json.dump(self.get_stats(), f, indent=2)
+        return path
+
+    # -- diagram (reference builds a Graphviz PDF/SVG) ---------------------
+    def to_dot(self) -> str:
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;",
+                 "  node [shape=box, style=rounded];"]
+        for s in self._stages:
+            label = s.describe().replace('"', "'")
+            par = "|".join(str(o.parallelism) for o in s.ops)
+            lines.append(f'  s{s.id} [label="{label}\\n({par})"];')
+        for s in self._stages:
+            for e in s.upstreams:
+                style = ""
+                if e.branch is not None:
+                    style = f' [label="b{e.branch}"]'
+                lines.append(f"  s{e.stage.id} -> s{s.id}{style};")
+        lines.append("}")
+        return "\n".join(lines)

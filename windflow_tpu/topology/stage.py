@@ -1,0 +1,94 @@
+"""Internal graph IR: Stage = one (possibly chained) operator group.
+
+The reference builds its DAG as nested FastFlow all-to-alls ("matrioska",
+``wf/multipipe.hpp:96-1329``); that encoding exists to satisfy FastFlow's
+container types. Our runtime needs no such constraint, so the topology is a
+plain DAG of stages; the *semantics* preserved from the reference are:
+
+- Case 2 (same parallelism, FORWARD): one-to-one edges, order-preserving
+  (``wf/multipipe.hpp:481-496``);
+- Case 3 (shuffle): every producer replica connects to every consumer
+  replica, with the emitter kind chosen by the consumer's routing
+  (``wf/multipipe.hpp:497-531``, ``create_emitter`` L248-362);
+- chaining fuses same-thread stages (``wf/multipipe.hpp:537-590``);
+- the collector in front of each consumer replica is chosen by execution
+  mode (``wf/multipipe.hpp:200-244``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
+from ..operators.base import BasicOperator
+
+
+class UpstreamEdge:
+    """Producer side of an edge into a stage."""
+
+    __slots__ = ("stage", "branch")
+
+    def __init__(self, stage: "Stage", branch: Optional[int]) -> None:
+        self.stage = stage  # producer stage
+        self.branch = branch  # split branch index on the producer, or None
+
+
+class Stage:
+    _next_id = 0
+
+    def __init__(self, op: BasicOperator) -> None:
+        self.id = Stage._next_id
+        Stage._next_id += 1
+        self.ops: List[BasicOperator] = [op]  # chained operators, in order
+        self.upstreams: List[UpstreamEdge] = []
+        self.downstream: Optional["Stage"] = None  # exclusive with split
+        self.split_logic: Optional[Callable] = None
+        self.split_branches: List[Optional["Stage"]] = []
+        self.split_tpu = False  # split after a device-batch operator
+        # runtime artifacts (filled at build time)
+        self.channels: List[Any] = []  # one Channel per replica
+        self.workers: List[Any] = []
+        self.built = False
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def first_op(self) -> BasicOperator:
+        return self.ops[0]
+
+    @property
+    def last_op(self) -> BasicOperator:
+        return self.ops[-1]
+
+    @property
+    def parallelism(self) -> int:
+        return self.ops[0].parallelism
+
+    @property
+    def is_source(self) -> bool:
+        return self.first_op.op_type == OpType.SOURCE
+
+    @property
+    def is_sink(self) -> bool:
+        return self.last_op.op_type == OpType.SINK
+
+    @property
+    def is_split(self) -> bool:
+        return self.split_logic is not None
+
+    def can_chain(self, op: BasicOperator) -> bool:
+        """Reference chaining rule: FORWARD input, same parallelism, and the
+        new operator must be chain-compatible (``wf/multipipe.hpp:537-590``,
+        Reduce/windows excluded at 1058-1060)."""
+        return (op.is_chainable
+                and op.input_routing in (RoutingMode.FORWARD,)
+                and op.parallelism == self.parallelism
+                and not self.is_split
+                and not self.is_sink
+                and self.last_op.op_type not in (OpType.WIN, OpType.JOIN,
+                                                 OpType.WIN_TPU, OpType.TPU))
+
+    def chain(self, op: BasicOperator) -> None:
+        self.ops.append(op)
+
+    def describe(self) -> str:
+        return "∘".join(o.name for o in self.ops)
