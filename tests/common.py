@@ -98,3 +98,45 @@ def rand_degree(rng: random.Random, lo: int = 1, hi: int = 4) -> int:
 
 def rand_batch(rng: random.Random) -> int:
     return rng.choice([0, 0, 1, 4, 32])
+
+
+def expected_windows(key_seqs, win, slide, win_type_cb, agg):
+    """Model of the reference windowing semantics: per key, windows
+    ``w`` cover index range [w*slide, w*slide+win) where the index is the
+    arrival position (CB) or the timestamp (TB); a window exists once any
+    index >= w*slide was seen. Returns {(key, wid): agg(values_in_window)}."""
+    import math
+    out = {}
+    for key, seq in key_seqs.items():
+        if not seq:
+            continue
+        idxs = [i if win_type_cb else ts for i, (v, ts) in enumerate(seq)]
+        mx = max(idxs)
+        if win >= slide:
+            last_w = math.ceil((mx + 1) / slide) - 1
+        else:
+            last_w = mx // slide
+        for w in range(last_w + 1):
+            lo, hi = w * slide, w * slide + win
+            vals = [v for (v, ts), idx in zip(seq, idxs) if lo <= idx < hi]
+            out[(key, w)] = agg(vals)
+    return out
+
+
+class WinCollector:
+    """Sink accumulator for WinResult streams: {(key, wid): value}."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.results = {}
+        self.dups = 0
+
+    def sink(self, r):
+        if r is None:
+            return
+        with self._lock:
+            k = (r.key, r.wid)
+            if k in self.results:
+                self.dups += 1
+            self.results[k] = r.value

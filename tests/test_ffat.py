@@ -1,0 +1,164 @@
+"""FlatFAT tree unit tests + Ffat_Windows operator tests (reference
+win_tests FAT cases): CB/TB, sum and non-commutative combines, partial
+windows at EOS, randomized degrees."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Ffat_Windows_Builder, FlatFAT,
+                          PipeGraph, Sink_Builder, Source_Builder, TimePolicy)
+
+from common import TupleT, WinCollector, expected_windows, rand_degree
+from test_windows import (N_KEYS, SLIDE_CB, SLIDE_US, STREAM_LEN, TS_STEP,
+                          WIN_CB, WIN_US, make_keyed_event_source, model_seqs)
+
+
+# ---------------------------------------------------------------------------
+# FlatFAT unit tests against a naive model
+# ---------------------------------------------------------------------------
+def test_flatfat_sliding_vs_naive():
+    rng = random.Random(3)
+    fat = FlatFAT(16, lambda a, b: a + b)
+    window = []
+    for i in range(500):
+        v = rng.randint(-5, 9)
+        fat.push(v)
+        window.append(v)
+        if len(window) > 13:
+            fat.pop(len(window) - 13)
+            window = window[-13:]
+        assert fat.query_all() == sum(window)
+        if len(window) >= 4:
+            assert fat.query_logical(1, 3) == sum(window[1:4])
+
+
+def test_flatfat_noncommutative_order():
+    """String concatenation: results must be in logical insertion order even
+    when the ring wraps."""
+    fat = FlatFAT(8, lambda a, b: a + b)
+    seq = []
+    for i in range(30):
+        s = chr(ord('a') + i % 26)
+        fat.push(s)
+        seq.append(s)
+        if len(seq) > 6:
+            fat.pop(len(seq) - 6)
+            seq = seq[-6:]
+        assert fat.query_all() == "".join(seq)
+
+
+def test_flatfat_identity_placeholders():
+    fat = FlatFAT(8, lambda a, b: a + b)
+    fat.push(None)
+    fat.push(3)
+    fat.push(None)
+    fat.push(4)
+    assert fat.query_all() == 7
+    fat.pop(2)
+    assert fat.query_all() == 4
+
+
+# ---------------------------------------------------------------------------
+# Ffat_Windows operator
+# ---------------------------------------------------------------------------
+def ffat_sum_agg(vals):
+    return sum(vals) if vals else None  # empty windows carry identity
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("win,slide", [(WIN_CB, SLIDE_CB), (8, 8), (3, 7)])
+def test_ffat_cb(mode, win, slide):
+    rng = random.Random(41)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), win, slide,
+                                True, ffat_sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("fat_cb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    fat = (Ffat_Windows_Builder(lambda t: t.value, lambda a, b: a + b)
+           .with_key_by(lambda t: t.key).with_cb_windows(win, slide)
+           .with_parallelism(rand_degree(rng)).build())
+    graph.add_source(src).add(fat).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("win,slide", [(WIN_US, SLIDE_US), (800, 800)])
+def test_ffat_tb(mode, win, slide):
+    rng = random.Random(43)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), win, slide,
+                                False, ffat_sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("fat_tb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    fat = (Ffat_Windows_Builder(lambda t: t.value, lambda a, b: a + b)
+           .with_key_by(lambda t: t.key).with_tb_windows(win, slide)
+           .with_parallelism(rand_degree(rng)).build())
+    graph.add_source(src).add(fat).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_ffat_tb_noncommutative():
+    """Ordered concat per window: validates ts-ordered pane combination with
+    a non-commutative combine (single source replica => deterministic)."""
+    expected = expected_windows(
+        {k: [(str(i % 10), i * TS_STEP) for i in range(STREAM_LEN)]
+         for k in range(2)},
+        WIN_US, SLIDE_US, False,
+        lambda vals: "".join(vals) if vals else None)
+    coll = WinCollector()
+    graph = PipeGraph("fat_nc", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i in range(STREAM_LEN):
+            ts = i * TS_STEP
+            for k in range(2):
+                shipper.push_with_timestamp(TupleT(k, i, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    fat = (Ffat_Windows_Builder(lambda t: str(t.value % 10),
+                                lambda a, b: a + b)
+           .with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+           .build())
+    graph.add_source(Source_Builder(src).build()).add(fat).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected
+
+
+def test_ffat_tb_lateness_disorder():
+    """Bounded disorder within the declared lateness must not lose tuples."""
+    disorder = 300
+    seqs = {}
+    rng = random.Random(9)
+    rows = []
+    for i in range(STREAM_LEN):
+        base = i * TS_STEP
+        ts = max(0, base - rng.randint(0, disorder))
+        rows.append((i + 1, ts))
+    seqs[0] = rows
+    expected = expected_windows(seqs, WIN_US, SLIDE_US, False, ffat_sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("fat_late", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i, (v, ts) in enumerate(rows):
+            shipper.push_with_timestamp(TupleT(0, v, ts), ts)
+            # monotone watermark bounded by the max possible disorder
+            shipper.set_next_watermark(max(0, i * TS_STEP - disorder))
+
+    fat = (Ffat_Windows_Builder(lambda t: t.value, lambda a, b: a + b)
+           .with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+           .with_lateness(disorder).build())
+    graph.add_source(Source_Builder(src).build()).add(fat).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected

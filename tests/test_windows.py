@@ -1,0 +1,210 @@
+"""Window operator tests mirroring the reference's win_tests suite:
+{Keyed, Parallel, Paned, MapReduce} x {CB, TB}, incremental and
+non-incremental, exact-value checks against a model of the windowing
+semantics, randomized parallelism sweeps."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder,
+                          MapReduce_Windows_Builder, Paned_Windows_Builder,
+                          Parallel_Windows_Builder, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy, WindFlowError)
+
+from common import TupleT, WinCollector, expected_windows, rand_degree
+
+N_KEYS = 5
+STREAM_LEN = 60
+TS_STEP = 137  # deliberately unaligned with window boundaries
+
+
+def make_keyed_event_source(n_keys, stream_len):
+    """EVENT_TIME source with disjoint keys per replica; per-key ts sequence
+    i*TS_STEP (deterministic model)."""
+
+    def src(shipper, ctx):
+        for i in range(stream_len):
+            ts = i * TS_STEP
+            for k in range(ctx.get_replica_index(), n_keys,
+                           ctx.get_parallelism()):
+                shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    return src
+
+
+def model_seqs(n_keys, stream_len):
+    return {k: [(i + 1 + k, i * TS_STEP) for i in range(stream_len)]
+            for k in range(n_keys)}
+
+
+def sum_agg(vals):
+    return sum(vals)
+
+
+WIN_US, SLIDE_US = 1000, 400  # TB spans several TS_STEPs
+WIN_CB, SLIDE_CB = 13, 5
+
+
+# ---------------------------------------------------------------------------
+# Keyed_Windows
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("incremental", [False, True])
+def test_keyed_windows_tb(mode, incremental):
+    rng = random.Random(5)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_agg)
+    for _ in range(3):
+        coll = WinCollector()
+        graph = PipeGraph("kw_tb", mode, TimePolicy.EVENT_TIME)
+        src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng)).build())
+        b = Keyed_Windows_Builder(
+            (lambda t, acc: acc + t.value) if incremental
+            else (lambda ws: sum(w.value for w in ws)))
+        b = b.with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+        if incremental:
+            b = b.incremental(0)
+        kw = b.with_parallelism(rand_degree(rng)).build()
+        graph.add_source(src).add(kw).add_sink(
+            Sink_Builder(coll.sink).with_parallelism(rand_degree(rng)).build())
+        graph.run()
+        assert coll.dups == 0
+        assert coll.results == expected
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("win,slide", [(WIN_CB, SLIDE_CB), (6, 6), (4, 9)])
+def test_keyed_windows_cb(mode, win, slide):
+    """CB sliding, tumbling, and hopping windows."""
+    rng = random.Random(11)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), win, slide,
+                                True, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("kw_cb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    kw = (Keyed_Windows_Builder(lambda ws: sum(w.value for w in ws))
+          .with_key_by(lambda t: t.key).with_cb_windows(win, slide)
+          .with_parallelism(rand_degree(rng)).build())
+    graph.add_source(src).add(kw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected
+
+
+# ---------------------------------------------------------------------------
+# Parallel_Windows
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_parallel_windows_tb(mode):
+    rng = random.Random(17)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("pw_tb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    pw = (Parallel_Windows_Builder(lambda ws: sum(w.value for w in ws))
+          .with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+          .with_parallelism(rand_degree(rng)).build())
+    graph.add_source(src).add(pw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_parallel_windows_cb_deterministic():
+    """CB + Parallel_Windows only in DETERMINISTIC mode (single source =>
+    deterministic per-key arrival order); DEFAULT mode must reject it."""
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_CB,
+                                SLIDE_CB, True, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("pw_cb", ExecutionMode.DETERMINISTIC,
+                      TimePolicy.EVENT_TIME)
+    src = Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN)).build()
+    pw = (Parallel_Windows_Builder(lambda ws: sum(w.value for w in ws))
+          .with_key_by(lambda t: t.key).with_cb_windows(WIN_CB, SLIDE_CB)
+          .with_parallelism(3).build())
+    graph.add_source(src).add(pw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected
+
+    g2 = PipeGraph("pw_cb_bad", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+    src2 = Source_Builder(make_keyed_event_source(1, 1)).build()
+    pw2 = (Parallel_Windows_Builder(lambda ws: 0)
+           .with_key_by(lambda t: t.key).with_cb_windows(4, 2)
+           .with_parallelism(2).build())
+    g2.add_source(src2).add(pw2).add_sink(Sink_Builder(lambda r: None).build())
+    with pytest.raises(WindFlowError):
+        g2.run()
+
+
+# ---------------------------------------------------------------------------
+# Paned_Windows (PLQ panes + WLQ combine)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+@pytest.mark.parametrize("incremental", [False, True])
+def test_paned_windows_tb(mode, incremental):
+    rng = random.Random(23)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("paw_tb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    if incremental:
+        b = (Paned_Windows_Builder(lambda t, acc: acc + t.value,
+                                   lambda v, acc: acc + v)
+             .incremental(0).incremental_stage2(0))
+    else:
+        b = Paned_Windows_Builder(lambda ws: sum(w.value for w in ws),
+                                  lambda vals: sum(vals))
+    paw = (b.with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+           .with_parallelism(rand_degree(rng), rand_degree(rng)).build())
+    graph.add_source(src).add(paw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+# ---------------------------------------------------------------------------
+# MapReduce_Windows (MAP partials + REDUCE merge)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_mapreduce_windows_tb(mode):
+    rng = random.Random(31)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("mrw_tb", mode, TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN))
+           .with_parallelism(rand_degree(rng)).build())
+    mrw = (MapReduce_Windows_Builder(lambda ws: sum(w.value for w in ws),
+                                     lambda vals: sum(vals))
+           .with_key_by(lambda t: t.key).with_tb_windows(WIN_US, SLIDE_US)
+           .with_parallelism(rand_degree(rng), rand_degree(rng)).build())
+    graph.add_source(src).add(mrw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_window_thread_count_composite():
+    """Composite window ops expand into two stages with their own replicas."""
+    graph = PipeGraph("paw_threads", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    src = Source_Builder(make_keyed_event_source(2, 5)).build()
+    paw = (Paned_Windows_Builder(lambda ws: 0, lambda vs: 0)
+           .with_key_by(lambda t: t.key).with_tb_windows(1000, 500)
+           .with_parallelism(2, 3).build())
+    coll = WinCollector()
+    graph.add_source(src).add(paw).add_sink(Sink_Builder(coll.sink).build())
+    assert graph.get_num_threads() == 1 + 2 + 3 + 1
+    graph.run()

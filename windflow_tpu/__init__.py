@@ -16,11 +16,19 @@ Import layering: ``import windflow_tpu`` pulls only the CPU plane (no jax);
 
 from .basic import (ExecutionMode, JoinMode, RoutingMode, TimePolicy,
                     WindFlowError, WinType)
-from .builders import (Filter_Builder, FlatMap_Builder, Map_Builder,
-                       Reduce_Builder, Sink_Builder, Source_Builder)
+from .builders import (Ffat_Windows_Builder, Filter_Builder,
+                       FlatMap_Builder, Keyed_Windows_Builder, Map_Builder,
+                       MapReduce_Windows_Builder, Paned_Windows_Builder,
+                       Parallel_Windows_Builder, Reduce_Builder, Sink_Builder,
+                       Source_Builder)
 from .context import LocalStorage, RuntimeContext
 from .message import Batch, Single
 from .operators.basic_ops import (Filter, FlatMap, Map, Reduce, Shipper, Sink)
+from .operators.ffat import Ffat_Windows
+from .operators.flatfat import FlatFAT
+from .operators.window_engine import WinResult
+from .operators.windows import (Keyed_Windows, MapReduce_Windows,
+                                Paned_Windows, Parallel_Windows)
 from .operators.source import Source, SourceShipper
 from .topology.multipipe import MultiPipe
 from .topology.pipegraph import PipeGraph
@@ -37,5 +45,10 @@ __all__ = [
     "Single", "Batch",
     "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder",
+    "Keyed_Windows", "Parallel_Windows", "Paned_Windows",
+    "MapReduce_Windows", "Ffat_Windows", "FlatFAT", "WinResult",
+    "Keyed_Windows_Builder", "Parallel_Windows_Builder",
+    "Paned_Windows_Builder", "MapReduce_Windows_Builder",
+    "Ffat_Windows_Builder",
     "__version__",
 ]

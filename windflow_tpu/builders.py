@@ -151,3 +151,156 @@ class Sink_Builder(_RoutableBuilder):
     def build(self) -> Sink:
         return self._finish(Sink(self._func, self._name, self._parallelism,
                                  self._routing, self._key_extractor))
+
+
+# ---------------------------------------------------------------------------
+# Window builders (reference wf/builders.hpp:743-782 add withCBWindows /
+# withTBWindows / withLateness on top of the basic surface)
+# ---------------------------------------------------------------------------
+from .basic import WinType  # noqa: E402
+from .operators.ffat import Ffat_Windows  # noqa: E402
+from .operators.windows import (Keyed_Windows, MapReduce_Windows,  # noqa: E402
+                                Paned_Windows, Parallel_Windows)
+
+
+class _WindowedBuilder(BasicBuilder):
+    def __init__(self, func):
+        super().__init__(func)
+        self._key_extractor = None
+        self._win_len = 0
+        self._slide_len = 0
+        self._win_type = None
+        self._lateness = 0
+        self._incremental = False
+        self._initial = None
+
+    def with_key_by(self, key_extractor):
+        self._key_extractor = key_extractor
+        return self
+
+    def with_cb_windows(self, win_len: int, slide_len: int):
+        self._win_type = WinType.CB
+        self._win_len, self._slide_len = win_len, slide_len
+        return self
+
+    def with_tb_windows(self, win_usec: int, slide_usec: int):
+        self._win_type = WinType.TB
+        self._win_len, self._slide_len = win_usec, slide_usec
+        return self
+
+    def with_lateness(self, lateness_usec: int):
+        self._lateness = lateness_usec
+        return self
+
+    def incremental(self, initial_value=None):
+        """Switch the window function to incremental form
+        ``func(tuple, acc) -> acc``; ``initial_value`` may be a value
+        (deep-copied per window) or a factory ``(key, gwid) -> acc``."""
+        self._incremental = True
+        self._initial = initial_value
+        return self
+
+    def _check_windows(self, what: str) -> None:
+        if self._win_type is None:
+            raise WindFlowError(f"{what}: call with_cb_windows() or "
+                                "with_tb_windows() first")
+
+
+class Keyed_Windows_Builder(_WindowedBuilder):
+    _default_name = "keyed_windows"
+
+    def build(self) -> Keyed_Windows:
+        self._check_windows("Keyed_Windows_Builder")
+        if self._key_extractor is None:
+            raise WindFlowError("Keyed_Windows_Builder: withKeyBy mandatory")
+        return self._finish(Keyed_Windows(
+            self._func, self._key_extractor, self._win_len, self._slide_len,
+            self._win_type, self._lateness, self._incremental, self._initial,
+            self._name, self._parallelism, self._output_batch_size))
+
+
+class Parallel_Windows_Builder(_WindowedBuilder):
+    _default_name = "parallel_windows"
+
+    def build(self) -> Parallel_Windows:
+        self._check_windows("Parallel_Windows_Builder")
+        if self._key_extractor is None:
+            raise WindFlowError("Parallel_Windows_Builder: withKeyBy mandatory")
+        return self._finish(Parallel_Windows(
+            self._func, self._key_extractor, self._win_len, self._slide_len,
+            self._win_type, self._lateness, self._incremental, self._initial,
+            self._name, self._parallelism, self._output_batch_size))
+
+
+class _TwoStageWindowedBuilder(_WindowedBuilder):
+    def __init__(self, func1, func2):
+        super().__init__(func1)
+        self._func2 = func2
+        self._incremental2 = False
+        self._initial2 = None
+        self._parallelism2 = 1
+
+    def incremental_stage2(self, initial_value=None):
+        self._incremental2 = True
+        self._initial2 = initial_value
+        return self
+
+    def with_parallelism(self, p1: int, p2: int = None):  # type: ignore[override]
+        super().with_parallelism(p1)
+        self._parallelism2 = p2 if p2 is not None else p1
+        return self
+
+
+class Paned_Windows_Builder(_TwoStageWindowedBuilder):
+    _default_name = "paned_windows"
+
+    def build(self) -> Paned_Windows:
+        self._check_windows("Paned_Windows_Builder")
+        if self._key_extractor is None:
+            raise WindFlowError("Paned_Windows_Builder: withKeyBy mandatory")
+        return self._finish(Paned_Windows(
+            self._func, self._func2, self._key_extractor, self._win_len,
+            self._slide_len, self._win_type, self._lateness,
+            self._incremental, self._initial, self._incremental2,
+            self._initial2, self._name, self._parallelism,
+            self._parallelism2, self._output_batch_size))
+
+
+class MapReduce_Windows_Builder(_TwoStageWindowedBuilder):
+    _default_name = "mapreduce_windows"
+
+    def build(self) -> MapReduce_Windows:
+        self._check_windows("MapReduce_Windows_Builder")
+        if self._key_extractor is None:
+            raise WindFlowError("MapReduce_Windows_Builder: withKeyBy mandatory")
+        return self._finish(MapReduce_Windows(
+            self._func, self._func2, self._key_extractor, self._win_len,
+            self._slide_len, self._win_type, self._lateness,
+            self._incremental, self._initial, self._incremental2,
+            self._initial2, self._name, self._parallelism,
+            self._parallelism2, self._output_batch_size))
+
+
+class Ffat_Windows_Builder(_WindowedBuilder):
+    """lift+combine FlatFAT aggregator (``wf/builders.hpp`` FFAT_Builder)."""
+
+    _default_name = "ffat_windows"
+
+    def __init__(self, lift_func, combine_func):
+        super().__init__(lift_func)
+        self._combine = combine_func
+
+    def incremental(self, initial_value=None):
+        raise WindFlowError(
+            "Ffat_Windows is inherently incremental via lift+combine; "
+            "incremental() does not apply (use Keyed_Windows_Builder for "
+            "seeded accumulators)")
+
+    def build(self) -> Ffat_Windows:
+        self._check_windows("Ffat_Windows_Builder")
+        if self._key_extractor is None:
+            raise WindFlowError("Ffat_Windows_Builder: withKeyBy mandatory")
+        return self._finish(Ffat_Windows(
+            self._func, self._combine, self._key_extractor, self._win_len,
+            self._slide_len, self._win_type, self._lateness, self._name,
+            self._parallelism, self._output_batch_size))

@@ -154,6 +154,51 @@ class OrderingCollector(BasicCollector):
         self._bufs = [deque() for _ in range(self.n_channels)]
 
 
+class IDSequencerCollector(BasicCollector):
+    """Per-key id sequencer in front of WLQ/REDUCE window stages (used in
+    EVERY execution mode — reference ``wf/multipipe.hpp:221-224`` installs an
+    Ordering_Collector in ID mode for ``Parallel_Windows_WLQ/REDUCE``).
+
+    Upstream PLQ/MAP replicas stamp each partial result with a dense global
+    id per key (pane id, or ``gwid*map_parallelism + replica``); this
+    collector releases them in exactly id order per key, so the consumer's
+    count-based windows see a deterministic sequence regardless of arrival
+    interleaving. Gaps never persist (the id space is dense per key across
+    producers); leftovers are drained in id order at EOS."""
+
+    def __init__(self, n_channels: int, next_node: Any,
+                 key_extractor) -> None:
+        super().__init__(n_channels, next_node, None)
+        self.key_of = key_extractor
+        self._next: dict = {}  # key -> next expected id
+        self._pending: dict = {}  # key -> {id: msg}
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        if msg.is_punct:
+            return  # watermark progress is carried by released messages
+        key = self.key_of(msg.payload)
+        nxt = self._next.get(key, 0)
+        if msg.id == nxt:
+            self.next_node.handle_msg(0, msg)
+            nxt += 1
+            pend = self._pending.get(key)
+            while pend:
+                m = pend.pop(nxt, None)
+                if m is None:
+                    break
+                self.next_node.handle_msg(0, m)
+                nxt += 1
+            self._next[key] = nxt
+        else:
+            self._pending.setdefault(key, {})[msg.id] = msg
+
+    def terminate(self) -> None:
+        for key, pend in self._pending.items():
+            for i in sorted(pend):
+                self.next_node.handle_msg(0, pend[i])
+        self._pending.clear()
+
+
 class KSlackCollector(BasicCollector):
     """Adaptive K-slack (``wf/kslack_collector.hpp:99-118``): K tracks the
     maximum observed disorder ``max_ts - ts``; buffered tuples are released in

@@ -57,6 +57,15 @@ class MultiPipe:
         """New stage connected from all open tails (shuffle or one-to-one
         chosen at wiring time per the reference's Case 2/Case 3)."""
         self._check_open("add")
+        subs = getattr(op, "sub_operators", None)
+        if subs is not None:
+            # composite operator (Paned/MapReduce windows): expand into
+            # consecutive stages (the reference nests two Parallel_Windows
+            # inside one operator; the runtime shape is identical)
+            op._used = True
+            for sub in subs:
+                self.add(sub)
+            return self
         self._claim(op)
         if op.op_type == OpType.JOIN and len(self.tail_groups) != 2:
             raise WindFlowError("Interval_Join must be added right after "

@@ -22,8 +22,9 @@ from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
                      RoutingMode, TimePolicy, WindFlowError)
 from ..operators.base import BasicOperator
 from ..runtime.channel import Channel, InlinePort, QueuePort
-from ..runtime.collectors import (AtomicCounter, KSlackCollector,
-                                  OrderingCollector, WatermarkCollector)
+from ..runtime.collectors import (AtomicCounter, IDSequencerCollector,
+                                  KSlackCollector, OrderingCollector,
+                                  WatermarkCollector)
 from ..runtime.emitters import (BasicEmitter, BroadcastEmitter, ForwardEmitter,
                                 KeyByEmitter, NullEmitter, SplittingEmitter)
 from ..runtime.worker import Worker
@@ -166,6 +167,11 @@ class PipeGraph:
     def _make_collector(self, stage: Stage, replica_idx: int):
         first_replica = stage.first_op.replicas[replica_idx]
         n_in = stage.channels[replica_idx].n_inputs
+        if getattr(stage.first_op, "collector_override", None) == "id":
+            # WLQ/REDUCE window stages sequence per-key result ids in every
+            # execution mode (reference wf/multipipe.hpp:221-224)
+            return IDSequencerCollector(n_in, first_replica,
+                                        stage.first_op.key_extractor)
         separator = None
         if stage.first_op.op_type == OpType.JOIN:
             a_stages = getattr(stage, "join_a_stages", [])
