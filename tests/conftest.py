@@ -8,3 +8,26 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _strip_remote_backends():
+    """Drop any non-CPU backend factory a sitecustomize hook registered.
+
+    On the TPU host, every interpreter registers a tunneled TPU backend at
+    startup; initializing it dials a single-claim relay, so a concurrently
+    running process (or a wedged relay) would HANG the test run at the first
+    jax.devices()/device_put. Tests must be hermetic on the local CPU
+    platform regardless of relay health."""
+    try:
+        import jax
+        # a sitecustomize hook may have imported jax at interpreter startup,
+        # freezing jax_platforms from the pre-override environment
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as xb
+        for name in [n for n in list(xb._backend_factories) if n != "cpu"]:
+            xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+_strip_remote_backends()

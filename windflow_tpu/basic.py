@@ -109,3 +109,25 @@ def identity(x):
 class WindFlowError(RuntimeError):
     """Topology / runtime error. The reference prints a colored message and
     ``exit(EXIT_FAILURE)``; we raise instead so tests can assert on misuse."""
+
+
+def as_key_fn(key):
+    """Normalize a key extractor: callables pass through; a string names a
+    tuple field (works for dataclass attributes and dict keys). String keys
+    are preferred for TPU operators — the key is then a device column and
+    keyed re-shards never need host tuple objects."""
+    if key is None or callable(key):
+        return key
+
+    if isinstance(key, str):
+        def field_key(payload, _name=key):
+            if isinstance(payload, dict):
+                return payload[_name]
+            return getattr(payload, _name)
+        return field_key
+    raise WindFlowError(f"invalid key extractor: {key!r}")
+
+
+def key_field_name(key):
+    """The device column name of a key extractor, or None for callables."""
+    return key if isinstance(key, str) else None

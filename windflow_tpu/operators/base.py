@@ -14,7 +14,8 @@ from __future__ import annotations
 import inspect
 from typing import Any, Callable, List, Optional
 
-from ..basic import ExecutionMode, OpType, RoutingMode, TimePolicy, WindFlowError
+from ..basic import (ExecutionMode, OpType, RoutingMode, TimePolicy,
+                     WindFlowError, as_key_fn, key_field_name)
 from ..context import RuntimeContext
 from ..message import Batch, Single
 from ..monitoring.stats import StatsRecord
@@ -53,7 +54,11 @@ class BasicOperator:
         self.name = name
         self.parallelism = parallelism
         self.input_routing = input_routing
-        self.key_extractor = key_extractor
+        # a string names a tuple field (device-column-friendly); normalize
+        # to a callable once here, remembering the field name for the
+        # device plane
+        self.key_field = key_field_name(key_extractor)
+        self.key_extractor = as_key_fn(key_extractor)
         self.output_batch_size = output_batch_size
         self.closing_func: Optional[Callable] = None
         self.replicas: List["BasicReplica"] = []
@@ -99,7 +104,7 @@ class BasicReplica:
     # -- wiring --------------------------------------------------------------
     def set_emitter(self, emitter: BasicEmitter) -> None:
         self.emitter = emitter
-        emitter.stats = self.stats
+        emitter.set_stats(self.stats)
 
     # -- message dispatch ----------------------------------------------------
     def handle_msg(self, ch: int, msg: Any) -> None:

@@ -49,6 +49,9 @@ class BasicEmitter:
         self.stats = None  # optional StatsRecord of the owning replica
 
     # -- wiring ------------------------------------------------------------
+    def set_stats(self, stats) -> None:
+        self.stats = stats
+
     def set_ports(self, ports: Sequence[Port]) -> None:
         assert len(ports) == self.num_dests, (len(ports), self.num_dests)
         self.ports = list(ports)

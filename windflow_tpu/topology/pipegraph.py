@@ -19,7 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
-                     RoutingMode, TimePolicy, WindFlowError)
+                     RoutingMode, TimePolicy, WindFlowError, as_key_fn)
 from ..operators.base import BasicOperator
 from ..runtime.channel import Channel, InlinePort, QueuePort
 from ..runtime.collectors import (AtomicCounter, IDSequencerCollector,
@@ -134,23 +134,26 @@ class PipeGraph:
         routing = first.input_routing
         obs = producer.last_op.output_batch_size
         n_dests = consumer.parallelism
+        p_tpu = getattr(producer.last_op, "is_tpu", False)
+        c_tpu = getattr(first, "is_tpu", False)
+        if c_tpu and not p_tpu and obs <= 0:
+            # reference: a GPU operator's predecessor must declare an output
+            # batch size (wf/multipipe.hpp:457-460)
+            raise WindFlowError(
+                f"operator {producer.last_op.name!r} feeds TPU operator "
+                f"{first.name!r} but declares no output batch size; call "
+                "with_output_batch_size(n) on the producer")
         one_to_one = (routing is RoutingMode.FORWARD
                       and branch is None
+                      and not (c_tpu and not p_tpu)
                       and producer.parallelism == n_dests)
         if routing is RoutingMode.BROADCAST:
             for op in consumer.ops:
                 for r in op.replicas:
                     r.copy_on_write = True
         for pi, pr in enumerate(producer.last_op.replicas):
-            if routing is RoutingMode.KEYBY:
-                em: BasicEmitter = KeyByEmitter(first.key_extractor, n_dests,
-                                                obs, self.execution_mode)
-            elif routing is RoutingMode.BROADCAST:
-                em = BroadcastEmitter(n_dests, obs, self.execution_mode)
-            elif one_to_one:
-                em = ForwardEmitter(1, obs, self.execution_mode)
-            else:  # FORWARD shuffle / REBALANCING
-                em = ForwardEmitter(n_dests, obs, self.execution_mode)
+            em = self._create_edge_emitter(first, routing, obs, n_dests,
+                                           p_tpu, c_tpu, one_to_one)
             if one_to_one:
                 ports = [QueuePort(consumer.channels[pi])]
             else:
@@ -164,6 +167,48 @@ class PipeGraph:
                 pr._split_inner[branch] = em
                 em.stats = pr.stats
 
+    def _create_edge_emitter(self, first: BasicOperator, routing: RoutingMode,
+                             obs: int, n_dests: int, p_tpu: bool,
+                             c_tpu: bool, one_to_one: bool) -> BasicEmitter:
+        """Emitter kind per (device-plane, routing) — the reference's
+        create_emitter (``wf/multipipe.hpp:248-362``) plus the GPU-emitter
+        template cases (<inputGPU, outputGPU>)."""
+        if c_tpu and not p_tpu:  # CPU -> TPU staging boundary
+            from ..tpu.emitters_tpu import TPUStageEmitter
+            routing_name = ("keyby" if routing is RoutingMode.KEYBY else
+                            "broadcast" if routing is RoutingMode.BROADCAST
+                            else "forward")
+            return TPUStageEmitter(n_dests, obs,
+                                   getattr(first, "schema", None),
+                                   as_key_fn(first.key_extractor),
+                                   routing_name, self.execution_mode)
+        if p_tpu and c_tpu:  # device -> device
+            from ..tpu.emitters_tpu import (TPUBroadcastEmitter,
+                                            TPUForwardEmitter,
+                                            TPUKeyByEmitter)
+            if routing is RoutingMode.KEYBY:
+                return TPUKeyByEmitter(first.key_extractor, n_dests,
+                                       self.execution_mode,
+                                       key_field=first.key_field)
+            if routing is RoutingMode.BROADCAST:
+                return TPUBroadcastEmitter(n_dests, 0, self.execution_mode)
+            return TPUForwardEmitter(1 if one_to_one else n_dests, 0,
+                                     self.execution_mode)
+        if routing is RoutingMode.KEYBY:
+            em: BasicEmitter = KeyByEmitter(as_key_fn(first.key_extractor),
+                                            n_dests, obs,
+                                            self.execution_mode)
+        elif routing is RoutingMode.BROADCAST:
+            em = BroadcastEmitter(n_dests, obs, self.execution_mode)
+        elif one_to_one:
+            em = ForwardEmitter(1, obs, self.execution_mode)
+        else:  # FORWARD shuffle / REBALANCING
+            em = ForwardEmitter(n_dests, obs, self.execution_mode)
+        if p_tpu and not c_tpu:  # device -> host exit
+            from ..tpu.emitters_tpu import TPUExitEmitter
+            return TPUExitEmitter(em)
+        return em
+
     def _make_collector(self, stage: Stage, replica_idx: int):
         first_replica = stage.first_op.replicas[replica_idx]
         n_in = stage.channels[replica_idx].n_inputs
@@ -171,7 +216,7 @@ class PipeGraph:
             # WLQ/REDUCE window stages sequence per-key result ids in every
             # execution mode (reference wf/multipipe.hpp:221-224)
             return IDSequencerCollector(n_in, first_replica,
-                                        stage.first_op.key_extractor)
+                                        as_key_fn(stage.first_op.key_extractor))
         separator = None
         if stage.first_op.op_type == OpType.JOIN:
             a_stages = getattr(stage, "join_a_stages", [])
@@ -211,6 +256,11 @@ class PipeGraph:
         if self._started:
             raise WindFlowError("PipeGraph already started")
         self._validate()
+        if any(getattr(op, "is_tpu", False) for op in self._ops):
+            # initialize the JAX backend on the MAIN thread: lazy first-touch
+            # inside a worker thread can deadlock the PJRT client handshake
+            import jax
+            jax.devices()
         self._build()
         self._started = True
         self._t0 = time.monotonic()
@@ -242,6 +292,15 @@ class PipeGraph:
             raise WindFlowError("empty PipeGraph: no sources")
         for s in self._stages:
             if s.is_split:
+                if getattr(s.last_op, "is_tpu", False):
+                    # per-tuple splitting logic runs on the host; split after
+                    # a device operator needs an explicit exit (CPU Map)
+                    # first — same restriction as the reference's split_gpu
+                    # needing a host transfer (wf/splitting_emitter_gpu.hpp)
+                    raise WindFlowError(
+                        f"cannot split directly after TPU operator "
+                        f"{s.last_op.name!r}; insert a CPU operator to exit "
+                        "the device plane first")
                 missing = [b for b, st in enumerate(s.split_branches)
                            if st is None]
                 if missing:

@@ -1,0 +1,17 @@
+"""Device plane: JAX/XLA siblings of the reference's CUDA operators.
+
+Loaded lazily — ``import windflow_tpu`` never imports jax; importing
+``windflow_tpu.tpu`` does.
+"""
+
+from .schema import TupleSchema
+from .batch import BatchTPU
+from .ops_tpu import Filter_TPU, Map_TPU, Reduce_TPU
+from .builders_tpu import (Filter_TPU_Builder, Map_TPU_Builder,
+                           Reduce_TPU_Builder)
+
+__all__ = [
+    "TupleSchema", "BatchTPU",
+    "Map_TPU", "Filter_TPU", "Reduce_TPU",
+    "Map_TPU_Builder", "Filter_TPU_Builder", "Reduce_TPU_Builder",
+]

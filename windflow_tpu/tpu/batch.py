@@ -1,0 +1,127 @@
+"""BatchTPU: a micro-batch resident in device HBM.
+
+This is the ``batch_tpu_t`` called for by BASELINE.json — the sibling of the
+reference's ``Batch_GPU_t`` (``wf/batch_gpu_t.hpp:51-243``): a device buffer
+of tuples plus key metadata, with the same message protocol (watermark,
+punctuation flag, stream tag) as the CPU batches.
+
+Differences by design (TPU/XLA instead of CUDA):
+- storage is columnar (struct-of-arrays) because XLA programs want vector
+  lanes, not arrays of structs;
+- capacity is a power-of-two bucket with an explicit host-side ``size``
+  (pad+mask replaces the reference's variable-size batches — fixed shapes
+  avoid re-compiles, SURVEY.md §7 step 3b);
+- instead of the reference's per-key linked index chains
+  (``start_idxs_gpu``/``map_idxs_gpu``), keyed operators use a dense
+  ``key_slots`` int32 column (host dictionary key -> slot id), which is the
+  sort/segment-friendly encoding XLA wants;
+- there is no per-batch CUDA stream: JAX dispatch is async and XLA orders
+  executions on the device queue, which plays the same overlap role
+  (``batch_gpu_t.hpp:64`` per-batch stream + double buffering).
+
+``ts`` stays host-side int64 (microsecond timestamps outlive int32); device
+code needing event time rebases per batch (see ffat_tpu).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..message import StreamMsg
+from .schema import TupleSchema
+
+
+def bucket_capacity(n: int, minimum: int = 8) -> int:
+    c = minimum
+    while c < n:
+        c <<= 1
+    return c
+
+
+class BatchTPU(StreamMsg):
+    __slots__ = ("fields", "ts_host", "size", "capacity", "wm", "is_punct",
+                 "stream_tag", "id", "schema", "host_keys", "key_slots",
+                 "slot_of_key")
+
+    def __init__(self, fields: Dict[str, Any], ts_host: np.ndarray, size: int,
+                 schema: TupleSchema, wm: int = 0,
+                 host_keys: Optional[List[Any]] = None,
+                 key_slots: Any = None,
+                 slot_of_key: Optional[Dict[Any, int]] = None) -> None:
+        self.fields = fields  # name -> jax.Array (capacity,)
+        self.ts_host = ts_host  # np.int64 (capacity,)
+        self.size = size
+        self.capacity = len(ts_host)
+        self.wm = wm
+        self.is_punct = False
+        self.stream_tag = 0
+        self.id = 0
+        self.schema = schema
+        # keyed metadata (present on keyby-staged batches):
+        self.host_keys = host_keys  # list of python keys, len == size
+        self.key_slots = key_slots  # jax int32 (capacity,): dense slot ids
+        self.slot_of_key = slot_of_key  # key -> slot id for this batch
+
+    # -- protocol ----------------------------------------------------------
+    def min_watermark(self) -> int:
+        return self.wm
+
+    def __len__(self) -> int:
+        return self.size
+
+    def nbytes(self) -> int:
+        return sum(int(np.dtype(v.dtype).itemsize) * self.capacity
+                   for v in self.fields.values())
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def stage(rows: Sequence[Tuple[Any, int]], schema: TupleSchema,
+              wm: int, keys: Optional[List[Any]] = None,
+              capacity: Optional[int] = None) -> "BatchTPU":
+        """CPU->TPU: columnarize and device_put (async dispatch; the
+        reference's pinned staging + async H2D, ``keyby_emitter_gpu.hpp:
+        443-505``)."""
+        import jax
+        import jax.numpy as jnp
+
+        cap = capacity or bucket_capacity(len(rows))
+        cols, ts = schema.to_columns(rows, cap)
+        dev_fields = {name: jax.device_put(col) for name, col in cols.items()}
+        # per-batch slot ids are computed by the consuming keyed operator
+        # (TPUReplicaBase.batch_slots); host_keys is the canonical metadata
+        return BatchTPU(dev_fields, ts, len(rows), schema, wm, keys)
+
+    # -- exit to host ------------------------------------------------------
+    def to_rows(self) -> List[Tuple[Any, int]]:
+        """TPU->CPU (the reference's ``transfer2CPU``,
+        ``batch_gpu_t.hpp:154-165``)."""
+        host_cols = {name: np.asarray(v) for name, v in self.fields.items()}
+        return self.schema.from_columns(host_cols, self.ts_host, self.size)
+
+    def with_fields(self, new_fields: Dict[str, Any]) -> "BatchTPU":
+        """Same metadata, new device columns (in-place operator output)."""
+        b = BatchTPU(new_fields, self.ts_host, self.size, self.schema,
+                     self.wm, self.host_keys, self.key_slots,
+                     self.slot_of_key)
+        b.stream_tag = self.stream_tag
+        b.id = self.id
+        return b
+
+    def copy_for_dest(self) -> "BatchTPU":
+        """Broadcast copy: device arrays are immutable, sharing is safe."""
+        b = BatchTPU(dict(self.fields), self.ts_host, self.size, self.schema,
+                     self.wm, self.host_keys, self.key_slots,
+                     self.slot_of_key)
+        b.stream_tag = self.stream_tag
+        b.id = self.id
+        return b
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.slot_of_key) if self.slot_of_key is not None else 0
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"<BatchTPU n={self.size}/{self.capacity} wm={self.wm} "
+                f"keys={self.num_keys}>")

@@ -1,0 +1,75 @@
+"""TPU builders — siblings of the reference's ``wf/builders_gpu.hpp``
+(Filter_GPU/Map_GPU/Reduce_GPU builders with withName/withParallelism/
+withKeyBy/withRebalancing), with ``with_schema`` replacing C++ type
+deduction (or inferred from the first tuple at the staging boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..basic import WindFlowError
+from ..builders import _RoutableBuilder
+from .ops_tpu import Filter_TPU, Map_TPU, Reduce_TPU
+from .schema import TupleSchema
+
+
+class _TPUBuilderMixin:
+    def with_schema(self, schema) -> "_TPUBuilderMixin":
+        if isinstance(schema, dict):
+            schema = TupleSchema(schema)
+        self._schema = schema
+        return self
+
+
+class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+    _default_name = "map_tpu"
+
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._schema: Optional[TupleSchema] = None
+        self._state_init: Any = None
+
+    def with_state(self, initial_state: Any) -> "Map_TPU_Builder":
+        """Per-key device state: switches the functor to
+        ``func(row, state) -> (row, state)`` scanned in arrival order."""
+        self._state_init = initial_state
+        return self
+
+    def build(self) -> Map_TPU:
+        if self._state_init is not None and self._key_extractor is None:
+            raise WindFlowError("Map_TPU_Builder: with_state requires "
+                                "with_key_by")
+        return self._finish(Map_TPU(self._func, self._name, self._parallelism,
+                                    self._routing, self._key_extractor,
+                                    self._output_batch_size, self._schema,
+                                    self._state_init))
+
+
+class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+    _default_name = "filter_tpu"
+
+    def __init__(self, pred: Callable) -> None:
+        super().__init__(pred)
+        self._schema: Optional[TupleSchema] = None
+
+    def build(self) -> Filter_TPU:
+        return self._finish(Filter_TPU(self._func, self._name,
+                                       self._parallelism, self._routing,
+                                       self._key_extractor,
+                                       self._output_batch_size, self._schema))
+
+
+class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+    _default_name = "reduce_tpu"
+
+    def __init__(self, combine: Callable) -> None:
+        super().__init__(combine)
+        self._schema: Optional[TupleSchema] = None
+
+    def build(self) -> Reduce_TPU:
+        if self._key_extractor is None:
+            raise WindFlowError("Reduce_TPU_Builder: withKeyBy is mandatory")
+        return self._finish(Reduce_TPU(self._func, self._key_extractor,
+                                       self._name, self._parallelism,
+                                       self._output_batch_size, self._schema))

@@ -1,0 +1,224 @@
+"""TPU edge emitters — the device-plane routing (reference
+``wf/forward_emitter_gpu.hpp`` / ``wf/keyby_emitter_gpu.hpp`` /
+``wf/broadcast_emitter_gpu.hpp``, template cases <inputGPU, outputGPU>).
+
+- TPUStageEmitter  (CPU -> TPU): accumulates rows + keys into columnar
+  staging and ships a ``BatchTPU`` per ``output_batch_size`` tuples. JAX
+  ``device_put`` dispatch is async, which provides the copy/compute overlap
+  the reference gets from double-buffered pinned staging
+  (``keyby_emitter_gpu.hpp:443-505``). KEYBY routing hashes on the host and
+  keeps one staging buffer per destination; partial batches flush on
+  punctuation/EOS (pad+mask instead of variable shapes).
+- TPUForward/Broadcast/KeyByEmitter (TPU -> TPU): batches pass by
+  reference (device arrays are immutable); a keyed re-shard gathers
+  per-destination sub-batches on device from host-computed index vectors
+  (the reference rebuilds its key-index maps with device sort/unique,
+  ``keyby_emitter_gpu.hpp:518-583`` — here the host key list is the
+  canonical metadata, so no device pass is needed).
+- TPUExitEmitter   (TPU -> CPU): D2H (``transfer2CPU``) then delegates rows
+  to a wrapped CPU emitter (``forward_emitter_gpu.hpp:323-326``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..basic import ExecutionMode
+from ..message import Batch
+from ..runtime.emitters import BasicEmitter
+from .batch import BatchTPU, bucket_capacity
+from .schema import TupleSchema
+
+
+class TPUStageEmitter(BasicEmitter):
+    """CPU->TPU staging. Routing: FORWARD round-robins full batches,
+    KEYBY partitions rows by key hash, BROADCAST ships shared batches."""
+
+    def __init__(self, num_dests: int, output_batch_size: int,
+                 schema: Optional[TupleSchema],
+                 key_extractor: Optional[Callable],
+                 routing: str = "forward",
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT) -> None:
+        super().__init__(num_dests, output_batch_size, execution_mode)
+        self.schema = schema
+        self.key_extractor = key_extractor
+        self.routing = routing
+        n_bufs = num_dests if routing == "keyby" else 1
+        self._rows: List[list] = [[] for _ in range(n_bufs)]
+        self._keys: List[list] = [[] for _ in range(n_bufs)]
+        self._wms: List[int] = [0] * n_bufs
+        self._rr = 0
+
+    def emit(self, payload: Any, ts: int, wm: int,
+             msg_id: Optional[int] = None) -> None:
+        if self.schema is None:
+            self.schema = TupleSchema.infer(payload)
+        key = (self.key_extractor(payload)
+               if self.key_extractor is not None else None)
+        buf = (hash(key) % self.num_dests) if self.routing == "keyby" else 0
+        rows = self._rows[buf]
+        if not rows or wm < self._wms[buf]:
+            self._wms[buf] = wm
+        rows.append((payload, ts))
+        if self.key_extractor is not None:
+            self._keys[buf].append(key)
+        if len(rows) >= self.output_batch_size:
+            self._ship(buf)
+        self._maybe_generate_punctuation(wm)
+
+    def _ship(self, buf: int) -> None:
+        rows = self._rows[buf]
+        if not rows:
+            return
+        keys = self._keys[buf] if self.key_extractor is not None else None
+        batch = BatchTPU.stage(rows, self.schema, self._wms[buf], keys,
+                               bucket_capacity(self.output_batch_size
+                                               if len(rows) <= self.output_batch_size
+                                               else len(rows)))
+        if self.stats is not None:
+            self.stats.outputs_sent += len(rows)
+            self.stats.device_bytes_h2d += batch.nbytes()
+        self._rows[buf] = []
+        self._keys[buf] = []
+        if self.routing == "keyby":
+            batch.id = self._next_ids[buf]
+            self._next_ids[buf] += 1
+            self.ports[buf].send(batch)
+        elif self.routing == "broadcast":
+            for d in range(self.num_dests):
+                out = batch.copy_for_dest() if d > 0 else batch
+                out.id = self._next_ids[d]
+                self._next_ids[d] += 1
+                self.ports[d].send(out)
+        else:  # forward round-robin
+            batch.id = self._next_ids[self._rr]
+            self._next_ids[self._rr] += 1
+            self.ports[self._rr].send(batch)
+            self._rr = (self._rr + 1) % self.num_dests
+
+    def flush(self) -> None:
+        for buf in range(len(self._rows)):
+            self._ship(buf)
+
+
+class TPUForwardEmitter(BasicEmitter):
+    """TPU->TPU forward: whole batches round-robin."""
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        d = getattr(self, "_rr", 0)
+        batch.id = self._next_ids[d]
+        self._next_ids[d] += 1
+        if self.stats is not None:
+            self.stats.outputs_sent += batch.size
+        self.ports[d].send(batch)
+        self._rr = (d + 1) % self.num_dests
+
+
+class TPUBroadcastEmitter(BasicEmitter):
+    """TPU->TPU broadcast: immutable device arrays are shared."""
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        for d in range(self.num_dests):
+            out = batch.copy_for_dest() if d > 0 else batch
+            out.id = self._next_ids[d]
+            self._next_ids[d] += 1
+            if self.stats is not None:
+                self.stats.outputs_sent += out.size
+            self.ports[d].send(out)
+
+
+class TPUKeyByEmitter(BasicEmitter):
+    """TPU->TPU keyed re-shard: per-destination sub-batches gathered on
+    device with host-computed index vectors."""
+
+    def __init__(self, key_extractor: Callable, num_dests: int,
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 key_field: Optional[str] = None) -> None:
+        super().__init__(num_dests, 0, execution_mode)
+        self.key_extractor = key_extractor
+        self.key_field = key_field
+
+    def _keys_of(self, batch: BatchTPU):
+        if batch.host_keys is not None:
+            return batch.host_keys
+        if self.key_field is None:
+            raise RuntimeError(
+                "keyed TPU re-shard needs host key metadata or a string "
+                "field-name key extractor (with_key_by('field'))")
+        return [v.item()
+                for v in np.asarray(batch.fields[self.key_field])[:batch.size]]
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        import jax
+
+        if self.num_dests == 1:
+            batch.id = self._next_ids[0]
+            self._next_ids[0] += 1
+            if self.stats is not None:
+                self.stats.outputs_sent += batch.size
+            self.ports[0].send(batch)
+            return
+        host_keys = self._keys_of(batch)
+        dests = np.fromiter((hash(k) % self.num_dests for k in host_keys),
+                            dtype=np.int64, count=batch.size)
+        for d in range(self.num_dests):
+            idx = np.nonzero(dests == d)[0]
+            if idx.size == 0:
+                continue
+            cap = bucket_capacity(idx.size)
+            gather = np.zeros(cap, dtype=np.int32)
+            gather[:idx.size] = idx
+            gidx = jax.device_put(gather)
+            sub_fields = {k: v[gidx] for k, v in batch.fields.items()}
+            ts2 = batch.ts_host[gather]
+            keys2 = [host_keys[j] for j in idx]
+            sub = BatchTPU(sub_fields, ts2, idx.size, batch.schema, batch.wm,
+                           keys2)
+            sub.stream_tag = batch.stream_tag
+            sub.id = self._next_ids[d]
+            self._next_ids[d] += 1
+            if self.stats is not None:
+                self.stats.outputs_sent += sub.size
+            self.ports[d].send(sub)
+
+
+class TPUExitEmitter(BasicEmitter):
+    """TPU->CPU: D2H the batch, then route rows through a wrapped CPU
+    emitter (which owns the real ports and batching policy)."""
+
+    def __init__(self, inner: BasicEmitter) -> None:
+        super().__init__(inner.num_dests, inner.output_batch_size,
+                         inner.execution_mode)
+        self.inner = inner
+
+    def set_ports(self, ports) -> None:
+        self.inner.set_ports(ports)
+        self.ports = self.inner.ports
+
+    def set_stats(self, stats) -> None:
+        self.stats = stats
+        self.inner.stats = stats
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        if self.stats is not None:
+            self.stats.device_bytes_d2h += batch.nbytes()
+        for payload, ts in batch.to_rows():
+            self.inner.emit(payload, ts, batch.wm)
+
+    def emit(self, payload: Any, ts: int, wm: int,
+             msg_id: Optional[int] = None) -> None:
+        self.inner.emit(payload, ts, wm, msg_id)
+
+    def propagate_punctuation(self, wm: int) -> None:
+        self.inner.propagate_punctuation(wm)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+    def send_eos_all(self) -> None:
+        self.inner.send_eos_all()
+
+    def eos_ports(self):
+        return self.inner.eos_ports()

@@ -1,0 +1,361 @@
+"""TPU operators: Map_TPU, Filter_TPU, Reduce_TPU.
+
+Siblings of the reference CUDA operators (``wf/map_gpu.hpp``,
+``wf/filter_gpu.hpp``, ``wf/reduce_gpu.hpp``), re-designed for XLA:
+
+- functors are JAX functions over a dict of columns (struct-of-arrays) —
+  the whole batch is one compiled program (the reference launches
+  grid-stride kernels per batch; XLA fuses the elementwise chain instead);
+- ``jax.jit`` is instantiated once per operator; XLA's own cache handles
+  one compile per capacity bucket (the reference caches launch configs per
+  batch size, ``map_gpu.hpp:251-277``);
+- Filter compacts via a stable sort on the keep-mask (the reference uses
+  ``thrust::copy_if``, ``filter_gpu.hpp:331-335``);
+- Reduce sorts by key slot and runs a segmented associative scan with the
+  user's combine, gathering segment tails — one result per key per batch,
+  exactly the reference semantics (``reduce_gpu.hpp:239-272``:
+  sort_by_key + reduce_by_key). The combine must be associative and
+  commutative (``API:78-80``);
+- stateful Map/Filter keep per-key state in a device-resident table
+  (slots × state pytree) updated by a masked ``lax.scan`` in arrival order —
+  replacing the reference's per-key CUDA state objects + cross-replica
+  spinlock (``map_gpu.hpp:233-295``, ``basic_gpu.hpp:142-233``) with a
+  functional state carry. Keyed TPU operators hold their state per replica
+  (keys are partitioned by the keyby shuffle), so no lock exists at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
+from ..operators.base import BasicOperator, BasicReplica
+from .batch import BatchTPU
+from .schema import TupleSchema
+
+
+# ---------------------------------------------------------------------------
+# shared replica machinery
+# ---------------------------------------------------------------------------
+class TPUReplicaBase(BasicReplica):
+    """Processes whole device batches; never iterates rows."""
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        if msg.is_punct:
+            self.stats.punct_received += 1
+            self._advance_wm(msg.wm)
+            self.on_punctuation(msg.wm)
+            return
+        if not isinstance(msg, BatchTPU):
+            raise WindFlowError(
+                f"{self.op.name}: TPU operator received a non-device message "
+                f"({type(msg).__name__}); the upstream operator must declare "
+                "an output batch size > 0")
+        self.stats.start_svc()
+        self.stats.inputs_received += msg.size
+        self.stats.device_batches_in += 1
+        self._advance_wm(msg.wm)
+        msg.wm = self.cur_wm
+        self.process_device_batch(msg)
+        self.stats.end_svc(msg.size)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        raise NotImplementedError
+
+    def _emit_batch(self, batch: BatchTPU) -> None:
+        self.stats.device_batches_out += 1
+        self.stats.device_programs_run += 1
+        self.emitter.emit_device_batch(batch)
+
+    # per-batch keys: host metadata when staged keyed, else the device key
+    # column named by a string key extractor
+    def batch_keys(self, batch: BatchTPU):
+        keys = batch.host_keys
+        if keys is None:
+            field = self.op.key_field
+            if field is None:
+                raise WindFlowError(
+                    f"{self.op.name}: keyed TPU operator needs keyed staging "
+                    "(with_key_by on the op) or a string field-name key")
+            keys = [v.item()
+                    for v in np.asarray(batch.fields[field])[:batch.size]]
+        return keys
+
+    def batch_slots(self, batch: BatchTPU):
+        import jax
+        keys = self.batch_keys(batch)
+        slot_of_key: Dict[Any, int] = {}
+        slots = np.zeros(batch.capacity, dtype=np.int32)
+        for i, k in enumerate(keys):
+            slots[i] = slot_of_key.setdefault(k, len(slot_of_key))
+        slots[batch.size:] = len(slot_of_key)  # padding segment
+        return jax.device_put(slots), slot_of_key
+
+
+class TPUOperatorBase(BasicOperator):
+    op_type = OpType.TPU
+    is_tpu = True
+
+    def __init__(self, name: str, parallelism: int, input_routing: RoutingMode,
+                 key_extractor, output_batch_size: int,
+                 schema: Optional[TupleSchema]) -> None:
+        super().__init__(name, parallelism, input_routing, key_extractor,
+                         output_batch_size)
+        self.schema = schema  # None => inferred at the staging boundary
+
+    @property
+    def is_chainable(self) -> bool:
+        return False
+
+    def configure(self, execution_mode, time_policy) -> None:
+        if execution_mode is not ExecutionMode.DEFAULT:
+            # reference: GPU operators only in DEFAULT mode (map_gpu.hpp:470-478)
+            raise WindFlowError(
+                f"{self.name}: TPU operators require DEFAULT execution mode")
+        super().configure(execution_mode, time_policy)
+
+
+# ---------------------------------------------------------------------------
+# Map_TPU
+# ---------------------------------------------------------------------------
+class Map_TPU(TPUOperatorBase):
+    """Stateless: ``func(fields) -> fields`` (elementwise over columns).
+    Stateful (``state_init`` given): ``func(row, state) -> (row, state)``
+    over scalars, scanned in arrival order with per-key state."""
+
+    def __init__(self, func: Callable, name: str = "map_tpu",
+                 parallelism: int = 1,
+                 input_routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None, output_batch_size: int = 0,
+                 schema: Optional[TupleSchema] = None,
+                 state_init: Any = None) -> None:
+        if state_init is not None and key_extractor is None:
+            raise WindFlowError(f"{name}: stateful Map_TPU requires a key "
+                                "extractor (KEYBY)")
+        super().__init__(name, parallelism,
+                         RoutingMode.KEYBY if state_init is not None
+                         else input_routing,
+                         key_extractor, output_batch_size, schema)
+        self.func = func
+        self.state_init = state_init
+
+    def build_replicas(self) -> None:
+        cls = StatefulMapTPUReplica if self.state_init is not None \
+            else MapTPUReplica
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
+
+
+class MapTPUReplica(TPUReplicaBase):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        import jax
+        self._jitted = jax.jit(op.func)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        out = self._jitted(batch.fields)
+        if not isinstance(out, dict):
+            raise WindFlowError(f"{self.op.name}: Map_TPU function must "
+                                "return a dict of columns")
+        self._emit_batch(batch.with_fields(out))
+
+
+class StatefulMapTPUReplica(TPUReplicaBase):
+    """Device-resident keyed state table + masked scan in arrival order."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        import jax
+        import jax.numpy as jnp
+
+        self.slot_of_key: Dict[Any, int] = {}
+        self.table_capacity = 64
+        self.table = None  # pytree of (table_capacity,)-arrays
+
+        func = op.func
+
+        def run(fields, ts_unused, slots, size, table):
+            valid = jnp.arange(next(iter(fields.values())).shape[0]) < size
+
+            def body(tbl, x):
+                row, slot, ok = x
+                state = jax.tree_util.tree_map(lambda a: a[slot], tbl)
+                new_row, new_state = func(row, state)
+                tbl = jax.tree_util.tree_map(
+                    lambda a, v: a.at[slot].set(
+                        jnp.where(ok, v, a[slot]).astype(a.dtype)),
+                    tbl, new_state)
+                out = {k: jnp.where(ok, new_row[k], row[k]) for k in row}
+                return tbl, out
+
+            table2, outs = jax.lax.scan(body, table, (fields, slots, valid))
+            return table2, outs
+
+        self._jitted = jax.jit(run)
+
+    def _ensure_table(self, n_keys_needed: int, sample_batch: BatchTPU):
+        import jax
+        import jax.numpy as jnp
+
+        if self.table is None:
+            init = self.op.state_init
+            self.table = jax.tree_util.tree_map(
+                lambda v: jnp.full((self.table_capacity,), v,
+                                   dtype=jnp.asarray(v).dtype), init)
+        while n_keys_needed > self.table_capacity:
+            self.table_capacity *= 2
+            init = self.op.state_init
+            old = self.table
+            fresh = jax.tree_util.tree_map(
+                lambda v: jnp.full((self.table_capacity,), v,
+                                   dtype=jnp.asarray(v).dtype), init)
+            self.table = jax.tree_util.tree_map(
+                lambda f, o: f.at[:o.shape[0]].set(o), fresh, old)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        import jax
+
+        slots = np.zeros(batch.capacity, dtype=np.int32)
+        for i, k in enumerate(self.batch_keys(batch)):
+            s = self.slot_of_key.get(k)
+            if s is None:
+                s = self.slot_of_key[k] = len(self.slot_of_key)
+            slots[i] = s
+        self._ensure_table(len(self.slot_of_key), batch)
+        table2, outs = self._jitted(batch.fields, None,
+                                    jax.device_put(slots), batch.size,
+                                    self.table)
+        self.table = table2
+        self._emit_batch(batch.with_fields(outs))
+
+
+# ---------------------------------------------------------------------------
+# Filter_TPU
+# ---------------------------------------------------------------------------
+class Filter_TPU(TPUOperatorBase):
+    """``pred(fields) -> bool column``; batch compacts in place."""
+
+    def __init__(self, pred: Callable, name: str = "filter_tpu",
+                 parallelism: int = 1,
+                 input_routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None, output_batch_size: int = 0,
+                 schema: Optional[TupleSchema] = None) -> None:
+        super().__init__(name, parallelism, input_routing, key_extractor,
+                         output_batch_size, schema)
+        self.pred = pred
+
+    def build_replicas(self) -> None:
+        self.replicas = [FilterTPUReplica(self, i)
+                         for i in range(self.parallelism)]
+
+
+class FilterTPUReplica(TPUReplicaBase):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        import jax
+        import jax.numpy as jnp
+
+        pred = op.pred
+
+        def run(fields, size):
+            n = next(iter(fields.values())).shape[0]
+            keep = pred(fields) & (jnp.arange(n) < size)
+            order = jnp.argsort(~keep, stable=True)  # keepers first, in order
+            out = {k: v[order] for k, v in fields.items()}
+            return out, order, jnp.sum(keep)
+
+        self._jitted = jax.jit(run)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        out, order, count = self._jitted(batch.fields, batch.size)
+        new_size = int(count)
+        order_np = np.asarray(order)
+        dropped = batch.size - new_size
+        self.stats.inputs_ignored += dropped
+        ts2 = batch.ts_host[order_np]
+        keys2 = None
+        if batch.host_keys is not None:
+            keys_arr = list(batch.host_keys) + \
+                [None] * (batch.capacity - len(batch.host_keys))
+            keys2 = [keys_arr[j] for j in order_np[:new_size]]
+        nb = BatchTPU(out, ts2, new_size, batch.schema, batch.wm, keys2)
+        nb.stream_tag = batch.stream_tag
+        if new_size > 0:
+            self._emit_batch(nb)
+
+    # empty batches are dropped entirely (the reference shrinks to zero and
+    # forwards; dropping is equivalent because watermarks flow via puncts)
+
+
+# ---------------------------------------------------------------------------
+# Reduce_TPU
+# ---------------------------------------------------------------------------
+class Reduce_TPU(TPUOperatorBase):
+    """Per-batch keyed combine: one output tuple per distinct key per batch
+    (``combine(fields_a, fields_b) -> fields``, associative+commutative).
+    With ``key_extractor=None``... not allowed: KEYBY is mandatory like the
+    reference's keyed variant; a global per-batch reduce is the keyed case
+    with a constant key."""
+
+    def __init__(self, combine: Callable, key_extractor,
+                 name: str = "reduce_tpu", parallelism: int = 1,
+                 output_batch_size: int = 0,
+                 schema: Optional[TupleSchema] = None) -> None:
+        if key_extractor is None:
+            raise WindFlowError(f"{name}: Reduce_TPU requires a key extractor")
+        super().__init__(name, parallelism, RoutingMode.KEYBY, key_extractor,
+                         output_batch_size, schema)
+        self.combine = combine
+
+    def build_replicas(self) -> None:
+        self.replicas = [ReduceTPUReplica(self, i)
+                         for i in range(self.parallelism)]
+
+
+class ReduceTPUReplica(TPUReplicaBase):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        import jax
+        import jax.numpy as jnp
+
+        combine = op.combine
+
+        def run(fields, slots):
+            order = jnp.argsort(slots, stable=True)
+            f = {k: v[order] for k, v in fields.items()}
+            s = slots[order]
+
+            def seg_op(a, b):
+                fa, sa = a
+                fb, sb = b
+                same = sa == sb
+                merged = combine(fa, fb)
+                # fields the combine does not return pass through unchanged
+                out = {k: jnp.where(same, merged.get(k, fb[k]), fb[k])
+                       for k in fb}
+                return out, sb
+
+            scanned, _ = jax.lax.associative_scan(seg_op, (f, s))
+            n = s.shape[0]
+            is_last = jnp.concatenate(
+                [s[1:] != s[:-1], jnp.ones((1,), dtype=bool)])
+            idx = jnp.nonzero(is_last, size=n, fill_value=n - 1)[0]
+            return {k: v[idx] for k, v in scanned.items()}
+
+        self._jitted = jax.jit(run)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        import jax
+        slots_dev, slot_of_key = self.batch_slots(batch)
+        out_fields = self._jitted(batch.fields, slots_dev)
+        n_out = len(slot_of_key)
+        if n_out == 0:
+            return
+        out_keys = list(slot_of_key.keys())  # insertion order == slot order
+        batch_ts = int(batch.ts_host[:batch.size].max()) if batch.size else 0
+        ts2 = np.full(batch.capacity, batch_ts, dtype=np.int64)
+        nb = BatchTPU(out_fields, ts2, n_out, batch.schema, batch.wm,
+                      out_keys)
+        nb.stream_tag = batch.stream_tag
+        self._emit_batch(nb)

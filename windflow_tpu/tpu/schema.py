@@ -1,0 +1,107 @@
+"""Tuple schemas: the bridge between row-Python payloads and columnar
+device batches.
+
+The reference runs arbitrary C++ structs through CUDA kernels; the TPU
+plane instead requires a declared (or inferred) mapping tuple -> columns of
+fixed dtypes, because XLA programs are compiled per shape/dtype. This is
+the "functor surface" decision called out in SURVEY.md §7 step 3a: device
+operators are JAX functions over a dict of arrays (struct-of-arrays), and
+the schema handles row<->column conversion at the device boundary.
+
+Numeric Python types map to TPU-friendly dtypes: int -> int32,
+float -> float32, bool -> bool_. Timestamps stay host-side as int64 numpy
+(microseconds can exceed int32; device code that needs event time rebases
+to a batch-local int32 offset, see ffat_tpu).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..basic import WindFlowError
+
+_DTYPE_MAP = {
+    int: np.int32,
+    float: np.float32,
+    bool: np.bool_,
+}
+
+
+class TupleSchema:
+    """Ordered field name -> numpy dtype, plus a row constructor."""
+
+    def __init__(self, fields: Dict[str, Any],
+                 constructor: Optional[Callable] = None) -> None:
+        self.fields: Dict[str, np.dtype] = {
+            name: np.dtype(dt) for name, dt in fields.items()}
+        self.constructor = constructor  # None => rows come back as dicts
+        self._names = list(self.fields)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def infer(payload: Any) -> "TupleSchema":
+        """Infer from a sample tuple: dataclass instances or dicts with
+        numeric scalar fields."""
+        if dataclasses.is_dataclass(payload):
+            flds = {}
+            for f in dataclasses.fields(payload):
+                v = getattr(payload, f.name)
+                dt = _DTYPE_MAP.get(type(v))
+                if dt is None:
+                    dt = np.asarray(v).dtype
+                flds[f.name] = dt
+            return TupleSchema(flds, type(payload))
+        if isinstance(payload, dict):
+            flds = {}
+            for k, v in payload.items():
+                dt = _DTYPE_MAP.get(type(v))
+                if dt is None:
+                    dt = np.asarray(v).dtype
+                flds[k] = dt
+            return TupleSchema(flds, None)
+        raise WindFlowError(
+            f"cannot infer a device schema from {type(payload).__name__}; "
+            "use dataclass/dict tuples or pass an explicit TupleSchema")
+
+    # ------------------------------------------------------------------
+    def to_columns(self, rows: Sequence[Tuple[Any, int]], capacity: int
+                   ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """Rows [(payload, ts)] -> padded columnar arrays + int64 ts."""
+        cols = {name: np.zeros(capacity, dtype=dt)
+                for name, dt in self.fields.items()}
+        ts = np.zeros(capacity, dtype=np.int64)
+        # access mode follows the PAYLOADS (an explicit dict schema may be
+        # used with dataclass tuples and vice versa)
+        by_item = bool(rows) and isinstance(rows[0][0], dict)
+        for i, (p, t) in enumerate(rows):
+            ts[i] = t
+            if by_item:
+                for name in self._names:
+                    cols[name][i] = p[name]
+            else:
+                for name in self._names:
+                    cols[name][i] = getattr(p, name)
+        return cols, ts
+
+    def from_columns(self, cols: Dict[str, np.ndarray], ts: np.ndarray,
+                     n: int) -> List[Tuple[Any, int]]:
+        """Columnar arrays -> rows [(payload, ts)] for the CPU plane."""
+        names = self._names
+        ctor = self.constructor
+        pulled = [np.asarray(cols[name])[:n] for name in names]
+        out = []
+        for i in range(n):
+            vals = {name: pulled[j][i].item() for j, name in enumerate(names)}
+            payload = ctor(**vals) if ctor is not None else vals
+            out.append((payload, int(ts[i])))
+        return out
+
+    def signature(self) -> Tuple:
+        """Hashable key for the compile cache."""
+        return tuple((name, str(dt)) for name, dt in self.fields.items())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"TupleSchema({self.fields})"
