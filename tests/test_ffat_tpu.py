@@ -1,0 +1,184 @@
+"""Ffat_Windows_TPU tests (reference tests/win_tests_gpu equivalents):
+device-plane sliding-window aggregation checked against the same window
+model used for the CPU operators, TB and CB, multi-key, with lateness and
+partial EOS flushes."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+from common import TupleT, expected_windows, rand_degree
+
+N_KEYS = 5
+STREAM_LEN = 120
+TS_STEP = 137
+WIN_US, SLIDE_US = 1000, 400
+WIN_CB, SLIDE_CB = 13, 5
+
+
+def make_src(n_keys, stream_len):
+    def src(shipper, ctx):
+        for i in range(stream_len):
+            ts = i * TS_STEP
+            for k in range(ctx.get_replica_index(), n_keys,
+                           ctx.get_parallelism()):
+                shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts), ts)
+            shipper.set_next_watermark(ts)
+    return src
+
+
+def model_seqs(n_keys, stream_len):
+    return {k: [(i + 1 + k, i * TS_STEP) for i in range(stream_len)]
+            for k in range(n_keys)}
+
+
+def sum_or_none(vals):
+    return sum(vals) if vals else None
+
+
+class DictWinCollector:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.results = {}
+        self.dups = 0
+
+    def sink(self, r):
+        if r is None:
+            return
+        with self._lock:
+            k = (r["key"], r["wid"])
+            if k in self.results:
+                self.dups += 1
+            self.results[k] = r["value"] if r["valid"] else None
+
+
+def run_ffat_tpu(win, slide, win_type_cb, n_keys=N_KEYS,
+                 stream_len=STREAM_LEN, src_par=1, op_par=1, nwpb=8,
+                 lateness=0, obs=32):
+    coll = DictWinCollector()
+    graph = PipeGraph("ffat_tpu", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_src(n_keys, stream_len))
+           .with_parallelism(src_par).with_output_batch_size(obs).build())
+    b = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b_: {"value": a["value"] + b_["value"]})
+         .with_key_by("key").with_lateness(lateness)
+         .with_num_win_per_batch(nwpb))
+    b = (b.with_cb_windows(win, slide) if win_type_cb
+         else b.with_tb_windows(win, slide))
+    op = b.with_parallelism(op_par).build()
+    graph.add_source(src).add(op).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    return coll
+
+
+@pytest.mark.parametrize("win,slide", [(WIN_US, SLIDE_US), (800, 800),
+                                       (300, 700)])
+def test_ffat_tpu_tb(win, slide):
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), win, slide,
+                                False, sum_or_none)
+    coll = run_ffat_tpu(win, slide, win_type_cb=False)
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+@pytest.mark.parametrize("win,slide", [(WIN_CB, SLIDE_CB), (8, 8), (3, 7)])
+def test_ffat_tpu_cb(win, slide):
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), win, slide,
+                                True, sum_or_none)
+    coll = run_ffat_tpu(win, slide, win_type_cb=True)
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_ffat_tpu_parallel_replicas():
+    """Keys partitioned across device replicas; randomized degrees."""
+    rng = random.Random(7)
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_or_none)
+    for _ in range(3):
+        coll = run_ffat_tpu(WIN_US, SLIDE_US, False,
+                            src_par=rand_degree(rng),
+                            op_par=rand_degree(rng),
+                            nwpb=rng.choice([1, 4, 16]),
+                            obs=rng.choice([16, 64]))
+        assert coll.results == expected
+
+
+def test_ffat_tpu_many_keys_growth():
+    """Key-capacity doubling: more keys than the initial 16-slot table."""
+    n_keys = 50
+    expected = expected_windows(model_seqs(n_keys, 40), 800, 800, False,
+                                sum_or_none)
+    coll = run_ffat_tpu(800, 800, False, n_keys=n_keys, stream_len=40)
+    assert coll.results == expected
+
+
+def test_ffat_tpu_lateness_disorder():
+    disorder = 300
+    rng = random.Random(9)
+    rows = []
+    for i in range(STREAM_LEN):
+        ts = max(0, i * TS_STEP - rng.randint(0, disorder))
+        rows.append((i + 1, ts))
+    expected = expected_windows({0: rows}, WIN_US, SLIDE_US, False,
+                                sum_or_none)
+
+    coll = DictWinCollector()
+    graph = PipeGraph("ffat_tpu_late", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i, (v, ts) in enumerate(rows):
+            shipper.push_with_timestamp(TupleT(0, v, ts), ts)
+            shipper.set_next_watermark(max(0, i * TS_STEP - disorder))
+
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b_: {"value": a["value"] + b_["value"]})
+          .with_key_by("key").with_tb_windows(WIN_US, SLIDE_US)
+          .with_lateness(disorder).build())
+    graph.add_source(Source_Builder(src).with_output_batch_size(16).build()) \
+        .add(op).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected
+
+
+def test_ffat_tpu_noncommutative_minmax():
+    """combine keeps (min, max) pairs — associative, order-insensitive for
+    values but exercises multi-field tree state."""
+    expected = {}
+    seqs = model_seqs(3, 60)
+    raw = expected_windows(seqs, WIN_US, SLIDE_US, False,
+                           lambda vs: (min(vs), max(vs)) if vs else None)
+    coll = DictWinCollector()
+    graph = PipeGraph("ffat_tpu_mm", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    src = (Source_Builder(make_src(3, 60))
+           .with_output_batch_size(32).build())
+    import jax.numpy as jnp
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"lo": f["value"], "hi": f["value"]},
+            lambda a, b_: {"lo": jnp.minimum(a["lo"], b_["lo"]),
+                           "hi": jnp.maximum(a["hi"], b_["hi"])})
+          .with_key_by("key").with_tb_windows(WIN_US, SLIDE_US).build())
+
+    res = {}
+    import threading
+    lock = threading.Lock()
+
+    def sink(r):
+        if r is not None and r["valid"]:
+            with lock:
+                res[(r["key"], r["wid"])] = (r["lo"], r["hi"])
+
+    graph.add_source(src).add(op).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    raw = {k: v for k, v in raw.items() if v is not None}
+    assert res == raw

@@ -1,0 +1,4 @@
+from .mesh import (make_key_mesh, sharded_keyby_window_step,
+                   make_sharded_state)
+
+__all__ = ["make_key_mesh", "sharded_keyby_window_step", "make_sharded_state"]

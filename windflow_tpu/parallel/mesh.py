@@ -1,0 +1,150 @@
+"""Multi-chip scale-out: key-sharded streaming state over a device mesh.
+
+The single-node reference has no distributed backend (SURVEY.md §5: FastFlow
+shared-memory queues only). This module is the new surface: the keyby
+shuffle — the core repartitioning primitive of the whole framework
+(``wf/keyby_emitter*.hpp``) — expressed as XLA collectives over a
+``jax.sharding.Mesh`` so keyed window state scales across chips:
+
+- mesh axes ``('key', 'data')``: ingestion is data-parallel along ``data``
+  (every chip stages its own micro-batches), keyed state is block-sharded
+  along ``key`` (shard ``s`` owns keys ``[s*k_local, (s+1)*k_local)``, so
+  global state row ``k`` is key ``k``);
+- one jitted step per global batch, written with ``shard_map``:
+  bucket-by-owner (local sort) -> ``lax.all_to_all`` along ``key`` (the
+  ICI shuffle replacing the reference's lock-free queues) -> masked
+  segment-sum into the local per-key pane accumulators -> ``psum`` along
+  ``data`` to merge the data-parallel contributions -> global metrics via
+  ``psum`` over both axes;
+- collectives ride ICI: the all_to_all moves only tuple payloads, state
+  never leaves its owner shard.
+
+This is the dry-run surface validated on a virtual CPU mesh; the same
+program runs unchanged on a real multi-chip TPU slice.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import numpy as np
+
+
+def make_key_mesh(n_devices: int):
+    """Largest 2D ('key', 'data') mesh for n devices (data axis >= 1)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()[:n_devices]
+    ka = n_devices
+    da = 1
+    # prefer a 2D mesh when the device count allows it
+    for cand in (2, 4):
+        if n_devices % cand == 0 and n_devices // cand >= 2:
+            da = cand
+            ka = n_devices // cand
+            break
+    arr = np.array(devs).reshape(ka, da)
+    return Mesh(arr, ("key", "data"))
+
+
+def make_sharded_state(mesh, n_keys: int, n_panes: int):
+    """Per-key pane accumulators sharded along the 'key' axis (replicated
+    along 'data'); zeros-initialized."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ka = mesh.shape["key"]
+    n_keys_padded = math.ceil(n_keys / ka) * ka
+    state = jnp.zeros((n_keys_padded, n_panes), jnp.float32)
+    counts = jnp.zeros((n_keys_padded, n_panes), jnp.int32)
+    sharding = NamedSharding(mesh, P("key", None))
+    return (jax.device_put(state, sharding),
+            jax.device_put(counts, sharding))
+
+
+def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
+                              local_batch: int):
+    """Builds the jitted global step: (state, counts, keys, values, panes)
+    -> (state', counts', global_tuple_count).
+
+    ``keys``/``values``/``panes`` are global arrays of shape
+    (ka*da*local_batch,) sharded over both mesh axes; the step re-shards
+    tuples to their key-owner chips with all_to_all and folds them into the
+    owner's pane accumulators.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ka = mesh.shape["key"]
+    da = mesh.shape["data"]
+    n_keys_padded = math.ceil(n_keys / ka) * ka
+    k_local = n_keys_padded // ka
+    # per-destination bucket capacity: worst case all local tuples go to one
+    # owner; pad to local_batch (masked)
+    C = local_batch
+
+    def local_step(state, counts, keys, values, panes):
+        # state/counts: (k_local, n_panes); keys/values/panes: (B,)
+        # BLOCK key ownership: shard s owns global keys
+        # [s*k_local, (s+1)*k_local), so returned global row k IS key k
+        B = keys.shape[0]
+        dest = jnp.minimum(keys // k_local, ka - 1).astype(jnp.int32)
+        # bucket tuples by destination shard: (ka, C) padded with mask
+        order = jnp.argsort(dest, stable=True)
+        dsort = dest[order]
+        ksort = keys[order]
+        vsort = values[order]
+        psort = panes[order]
+        # position of each tuple within its destination run
+        start_of_dest = jnp.searchsorted(dsort, jnp.arange(ka))
+        within = jnp.arange(B) - start_of_dest[dsort]
+        ok = within < C
+        bucket_k = jnp.full((ka, C), -1, dtype=keys.dtype)
+        bucket_v = jnp.zeros((ka, C), dtype=values.dtype)
+        bucket_p = jnp.zeros((ka, C), dtype=panes.dtype)
+        flat = dsort * C + jnp.minimum(within, C - 1)
+        bucket_k = bucket_k.reshape(-1).at[flat].set(
+            jnp.where(ok, ksort, -1), mode="drop").reshape(ka, C)
+        bucket_v = bucket_v.reshape(-1).at[flat].set(
+            jnp.where(ok, vsort, 0), mode="drop").reshape(ka, C)
+        bucket_p = bucket_p.reshape(-1).at[flat].set(
+            jnp.where(ok, psort, 0), mode="drop").reshape(ka, C)
+        # the ICI shuffle: block i of every chip goes to key-shard i
+        recv_k = lax.all_to_all(bucket_k, "key", 0, 0, tiled=True)
+        recv_v = lax.all_to_all(bucket_v, "key", 0, 0, tiled=True)
+        recv_p = lax.all_to_all(bucket_p, "key", 0, 0, tiled=True)
+        rk = recv_k.reshape(-1)
+        rv = recv_v.reshape(-1)
+        rp = recv_p.reshape(-1)
+        valid = rk >= 0
+        shard = lax.axis_index("key")
+        local_key = jnp.where(valid, rk - shard * k_local, 0).astype(jnp.int32)
+        pane_idx = jnp.where(valid, rp % n_panes, 0).astype(jnp.int32)
+        flat_idx = jnp.where(valid, local_key * n_panes + pane_idx,
+                             k_local * n_panes)
+        # accumulate the DELTA only, then merge deltas across the
+        # data-parallel replicas — psum of state+delta would multiply the
+        # pre-existing accumulators by the data-axis size every step
+        delta = jnp.zeros(k_local * n_panes, state.dtype).at[flat_idx].add(
+            jnp.where(valid, rv, 0), mode="drop").reshape(k_local, n_panes)
+        dcount = jnp.zeros(k_local * n_panes, counts.dtype).at[flat_idx].add(
+            jnp.where(valid, 1, 0), mode="drop").reshape(k_local, n_panes)
+        state = state + lax.psum(delta, "data")
+        counts = counts + lax.psum(dcount, "data")
+        n_tuples = lax.psum(jnp.sum(valid), ("key", "data"))
+        return state, counts, n_tuples
+
+    stepped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("key", None), P("key", None),
+                  P(("key", "data")), P(("key", "data")), P(("key", "data"))),
+        out_specs=(P("key", None), P("key", None), P()),
+    )
+    return jax.jit(stepped), n_keys_padded, ka * da * local_batch

@@ -19,7 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
-                     RoutingMode, TimePolicy, WindFlowError, as_key_fn)
+                     RoutingMode, TimePolicy, WindFlowError)
 from ..operators.base import BasicOperator
 from ..runtime.channel import Channel, InlinePort, QueuePort
 from ..runtime.collectors import (AtomicCounter, IDSequencerCollector,
@@ -180,7 +180,7 @@ class PipeGraph:
                             else "forward")
             return TPUStageEmitter(n_dests, obs,
                                    getattr(first, "schema", None),
-                                   as_key_fn(first.key_extractor),
+                                   first.key_extractor,
                                    routing_name, self.execution_mode)
         if p_tpu and c_tpu:  # device -> device
             from ..tpu.emitters_tpu import (TPUBroadcastEmitter,
@@ -195,9 +195,9 @@ class PipeGraph:
             return TPUForwardEmitter(1 if one_to_one else n_dests, 0,
                                      self.execution_mode)
         if routing is RoutingMode.KEYBY:
-            em: BasicEmitter = KeyByEmitter(as_key_fn(first.key_extractor),
-                                            n_dests, obs,
-                                            self.execution_mode)
+            # key_extractor is normalized to a callable by BasicOperator
+            em: BasicEmitter = KeyByEmitter(first.key_extractor, n_dests,
+                                            obs, self.execution_mode)
         elif routing is RoutingMode.BROADCAST:
             em = BroadcastEmitter(n_dests, obs, self.execution_mode)
         elif one_to_one:
@@ -216,7 +216,7 @@ class PipeGraph:
             # WLQ/REDUCE window stages sequence per-key result ids in every
             # execution mode (reference wf/multipipe.hpp:221-224)
             return IDSequencerCollector(n_in, first_replica,
-                                        as_key_fn(stage.first_op.key_extractor))
+                                        stage.first_op.key_extractor)
         separator = None
         if stage.first_op.op_type == OpType.JOIN:
             a_stages = getattr(stage, "join_a_stages", [])

@@ -7,11 +7,13 @@ Loaded lazily — ``import windflow_tpu`` never imports jax; importing
 from .schema import TupleSchema
 from .batch import BatchTPU
 from .ops_tpu import Filter_TPU, Map_TPU, Reduce_TPU
-from .builders_tpu import (Filter_TPU_Builder, Map_TPU_Builder,
-                           Reduce_TPU_Builder)
+from .ffat_tpu import Ffat_Windows_TPU
+from .builders_tpu import (Ffat_Windows_TPU_Builder, Filter_TPU_Builder,
+                           Map_TPU_Builder, Reduce_TPU_Builder)
 
 __all__ = [
     "TupleSchema", "BatchTPU",
-    "Map_TPU", "Filter_TPU", "Reduce_TPU",
+    "Map_TPU", "Filter_TPU", "Reduce_TPU", "Ffat_Windows_TPU",
     "Map_TPU_Builder", "Filter_TPU_Builder", "Reduce_TPU_Builder",
+    "Ffat_Windows_TPU_Builder",
 ]

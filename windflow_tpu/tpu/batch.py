@@ -33,6 +33,12 @@ from ..message import StreamMsg
 from .schema import TupleSchema
 
 
+def key_column_to_list(batch: "BatchTPU", field: str) -> list:
+    """D2H of the key column as a host list (one C call, no per-item
+    boxing loops)."""
+    return np.asarray(batch.fields[field])[:batch.size].tolist()
+
+
 def bucket_capacity(n: int, minimum: int = 8) -> int:
     c = minimum
     while c < n:

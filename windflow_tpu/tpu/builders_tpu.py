@@ -73,3 +73,54 @@ class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         return self._finish(Reduce_TPU(self._func, self._key_extractor,
                                        self._name, self._parallelism,
                                        self._output_batch_size, self._schema))
+
+
+class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+    """Sibling of the reference ``Ffat_WindowsGPU_Builder``
+    (``wf/builders_gpu.hpp:576`` adds withNumWinPerBatch)."""
+
+    _default_name = "ffat_windows_tpu"
+
+    def __init__(self, lift: Callable, combine: Callable) -> None:
+        super().__init__(lift)
+        self._combine = combine
+        self._schema: Optional[TupleSchema] = None
+        self._win_len = 0
+        self._slide_len = 0
+        self._win_type = None
+        self._lateness = 0
+        self._nwpb = 16
+
+    def with_cb_windows(self, win_len: int, slide_len: int):
+        from ..basic import WinType
+        self._win_type = WinType.CB
+        self._win_len, self._slide_len = win_len, slide_len
+        return self
+
+    def with_tb_windows(self, win_usec: int, slide_usec: int):
+        from ..basic import WinType
+        self._win_type = WinType.TB
+        self._win_len, self._slide_len = win_usec, slide_usec
+        return self
+
+    def with_lateness(self, lateness_usec: int):
+        self._lateness = lateness_usec
+        return self
+
+    def with_num_win_per_batch(self, n: int):
+        self._nwpb = n
+        return self
+
+    def build(self):
+        from .ffat_tpu import Ffat_Windows_TPU
+        if self._win_type is None:
+            raise WindFlowError("Ffat_Windows_TPU_Builder: call "
+                                "with_cb_windows() or with_tb_windows()")
+        if self._key_extractor is None:
+            raise WindFlowError("Ffat_Windows_TPU_Builder: withKeyBy "
+                                "is mandatory")
+        return self._finish(Ffat_Windows_TPU(
+            self._func, self._combine, self._key_extractor, self._win_len,
+            self._slide_len, self._win_type, self._lateness, self._nwpb,
+            self._name, self._parallelism, self._output_batch_size,
+            self._schema))

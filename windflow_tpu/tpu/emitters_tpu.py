@@ -147,8 +147,8 @@ class TPUKeyByEmitter(BasicEmitter):
             raise RuntimeError(
                 "keyed TPU re-shard needs host key metadata or a string "
                 "field-name key extractor (with_key_by('field'))")
-        return [v.item()
-                for v in np.asarray(batch.fields[self.key_field])[:batch.size]]
+        from .batch import key_column_to_list
+        return key_column_to_list(batch, self.key_field)
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
         import jax

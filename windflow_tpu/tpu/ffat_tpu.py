@@ -1,0 +1,506 @@
+"""Ffat_Windows_TPU: the flagship device operator — sliding-window
+lift+combine aggregation over a batched FlatFAT forest in HBM.
+
+Reference: ``wf/ffat_windows_gpu.hpp`` + ``wf/ffat_replica_gpu.hpp`` +
+``wf/flatfat_gpu.hpp`` (see SURVEY.md §3.5). The reference's per-batch GPU
+flow is: lift kernel -> thrust sort/reduce by (key, pane) -> small D2H of
+the unique (key, pane) arrays -> host loop per key pushing panes into a
+device ring and firing watermark-complete windows through a per-key FlatFAT
+(``Compute_Results_Kernel`` combines O(log B) nodes per window).
+
+TPU-first redesign:
+- the control plane runs on HOST METADATA ONLY: keys and timestamps are
+  already host-side on ``BatchTPU``, so segmentation (sort order, segment
+  runs), per-key pane bookkeeping, window-fire decisions and eviction lists
+  are all numpy — no D2H of data at all (the reference pays a D2H of its
+  unique arrays every batch, ``ffat_replica_gpu.hpp:945-988``);
+- the data plane is ONE jitted XLA program per batch:
+    lift(columns) -> gather(sort order) -> segmented associative scan with
+    the user combine -> gather segment tails -> scatter-combine into the
+    leaves of a FlatFAT FOREST (K_cap keys x 2F nodes, one segment tree
+    per key slot, circular leaf addressing ``pane mod F``) -> vectorized
+    level rebuild (log F fused passes over the whole forest) -> vmapped
+    iterative range queries for up to W_cap fired windows (each walks
+    <= 2 log F nodes with ordered left/right accumulators, safe for
+    non-commutative combines) -> leaf eviction;
+- all shapes are static per (cap, s_cap, K_cap, F) bucket; key capacity and
+  ring length grow by doubling with a device-side rebuild (the reference
+  resizes its pending-pane ring on demand, ``ffat_replica_gpu.hpp:219-260``).
+
+Window semantics match the CPU ``Ffat_Windows``: pane = gcd(win, slide)
+time units (TB) or one tuple (CB, leaf = per-key arrival index); TB windows
+fire when the watermark minus lateness passes their end; empty windows fire
+with ``valid=False``; late tuples behind the eviction frontier are counted
+as ignored; EOS flushes partial windows.
+
+Output batches carry one row per fired window: the combined value columns,
+``wid`` (per-key window id), ``valid`` (False for empty windows), and the
+key column when the key is a field name.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..basic import OpType, RoutingMode, WinType, WindFlowError
+from .batch import BatchTPU, bucket_capacity
+from .ops_tpu import TPUOperatorBase, TPUReplicaBase
+from .schema import TupleSchema
+
+class Ffat_Windows_TPU(TPUOperatorBase):
+    op_type = OpType.WIN_TPU
+
+    def __init__(self, lift: Callable, combine: Callable, key_extractor,
+                 win_len: int, slide_len: int,
+                 win_type: WinType = WinType.TB, lateness: int = 0,
+                 num_win_per_batch: int = 16,
+                 name: str = "ffat_windows_tpu", parallelism: int = 1,
+                 output_batch_size: int = 0,
+                 schema: Optional[TupleSchema] = None) -> None:
+        if key_extractor is None:
+            raise WindFlowError(f"{name}: requires a key extractor")
+        if win_len <= 0 or slide_len <= 0:
+            raise WindFlowError(f"{name}: win/slide must be > 0")
+        super().__init__(name, parallelism, RoutingMode.KEYBY, key_extractor,
+                         output_batch_size, schema)
+        self.lift = lift
+        self.combine = combine
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.win_type = win_type
+        self.lateness = lateness
+        self.num_win_per_batch = max(1, num_win_per_batch)
+        self.pane_len = math.gcd(win_len, slide_len)
+
+    def build_replicas(self) -> None:
+        self.replicas = [FfatTPUReplica(self, i)
+                         for i in range(self.parallelism)]
+
+
+class FfatTPUReplica(TPUReplicaBase):
+    def __init__(self, op: Ffat_Windows_TPU, idx: int) -> None:
+        super().__init__(op, idx)
+        if op.win_type is WinType.CB:
+            self.win_units = op.win_len
+            self.slide_units = op.slide_len
+        else:
+            self.win_units = op.win_len // op.pane_len
+            self.slide_units = op.slide_len // op.pane_len
+        # ring length: window + slack for panes ahead of the watermark
+        self.F = 1 << max(3, math.ceil(math.log2(
+            self.win_units + max(2 * self.slide_units, 16))))
+        self.K_cap = 16
+        self.W_cap = op.num_win_per_batch
+        self.slot_of_key: Dict[Any, int] = {}
+        self._out_keys_by_slot: List[Any] = []
+        # per-slot host bookkeeping (numpy, grown with K_cap)
+        self.next_fire = np.zeros(self.K_cap, dtype=np.int64)
+        self.fired = np.zeros(self.K_cap, dtype=np.int64)  # == next gwid
+        self.max_leaf = np.full(self.K_cap, -1, dtype=np.int64)
+        self.count = np.zeros(self.K_cap, dtype=np.int64)  # CB arrivals
+        self.ignored = 0
+        # device forest (lazily shaped once the lift output is known)
+        self.trees = None  # dict field -> (K_cap, 2F)
+        self.tvalid = None  # (K_cap, 2F) bool
+        self._step_cache: Dict[Any, Any] = {}
+        self._last_fields = None  # small field sample for data-less firing
+
+    # ==================================================================
+    # the per-batch device program
+    # ==================================================================
+    def _make_step(self, cap: int, s_cap: int):
+        import jax
+        import jax.numpy as jnp
+
+        lift = self.op.lift
+        combine = self.op.combine
+        F = self.F
+        K_cap = self.K_cap
+        NNODES = 2 * F
+        OOB = K_cap * NNODES  # scatter target for masked lanes (mode=drop)
+        LOGQ = NNODES.bit_length()  # enough iterations for the tree walk
+
+        tmap = jax.tree_util.tree_map
+
+        def comb_valid(va, a, vb, b):
+            """Ordered combine with validity: an invalid side passes the
+            other through (None-as-identity, like the CPU FlatFAT)."""
+            both = va & vb
+            merged = combine(a, b)
+            out = tmap(lambda m, x, y: jnp.where(both, m, jnp.where(va, x, y)),
+                       merged, a, b)
+            return va | vb, out
+
+        def range_query(tree_row, vrow, lo, length):
+            """Ordered combine of physical leaf range [lo, lo+length) of one
+            tree row: iterative segment-tree walk, left/right accumulators
+            keep combine order (reference prefix/suffix arrays,
+            ``wf/flatfat.hpp:85-132``)."""
+            zero = tmap(lambda a: jnp.zeros((), a.dtype), tree_row)
+
+            def body(_, st):
+                l, r, lv, la, rv, ra = st
+                take_l = ((l & 1) == 1) & (l < r)
+                il = jnp.clip(l, 0, NNODES - 1)
+                node_l = tmap(lambda a: a[il], tree_row)
+                lv, la = comb_valid(lv, la, vrow[il] & take_l, node_l)
+                l = jnp.where(take_l, l + 1, l)
+                take_r = ((r & 1) == 1) & (l < r)
+                ir = jnp.clip(r - 1, 0, NNODES - 1)
+                node_r = tmap(lambda a: a[ir], tree_row)
+                rv, ra = comb_valid(vrow[ir] & take_r, node_r, rv, ra)
+                r = jnp.where(take_r, r - 1, r)
+                return (l >> 1, r >> 1, lv, la, rv, ra)
+
+            init = (lo + F, lo + length + F,
+                    jnp.zeros((), bool), zero, jnp.zeros((), bool), zero)
+            st = jax.lax.fori_loop(0, LOGQ, body, init)
+            return comb_valid(st[2], st[3], st[4], st[5])
+
+        def window_query(tree_row, vrow, start_phys, length):
+            """Logical ring range -> <=2 physical ranges, combined in order."""
+            len1 = jnp.minimum(length, F - start_phys)
+            v1, r1 = range_query(tree_row, vrow, start_phys, len1)
+            v2, r2 = range_query(tree_row, vrow, jnp.zeros_like(start_phys),
+                                 length - len1)
+            return comb_valid(v1, r1, v2, r2)
+
+        def step(fields, order, same_prev, seg_pos, seg_slots, seg_leaves,
+                 seg_mask, trees, tvalid, fire_slots, fire_starts, fire_lens,
+                 fire_mask, evict_slots, evict_leaves, evict_mask):
+            # 1. lift + segmented inclusive scan per (key, leaf) run
+            vals = lift(fields)
+            svals = tmap(lambda a: a[order], vals)
+
+            def seg_op(a, b):
+                fa, sa = a
+                fb, same_b = b
+                merged = combine(fa, fb)
+                out = tmap(lambda m, y: jnp.where(same_b, m, y), merged, fb)
+                return out, sa & same_b
+
+            scanned, _ = jax.lax.associative_scan(seg_op, (svals, same_prev))
+            seg_vals = tmap(lambda a: a[seg_pos], scanned)  # (s_cap,)
+
+            # 2. scatter-combine segment tails into forest leaves
+            flat_idx = seg_slots * NNODES + (F + seg_leaves)
+            safe_idx = jnp.where(seg_mask, flat_idx, OOB)
+            gather_idx = jnp.where(seg_mask, flat_idx, 0)
+            leaf_valid = tvalid.reshape(-1)[gather_idx] & seg_mask
+            cur_leaves = tmap(lambda t: t.reshape(-1)[gather_idx], trees)
+            merged_all = combine(cur_leaves, seg_vals)
+            new_leaves = tmap(lambda m, sv: jnp.where(leaf_valid, m, sv),
+                              merged_all, seg_vals)
+            trees = tmap(
+                lambda t, nl: t.reshape(-1).at[safe_idx].set(
+                    nl, mode="drop").reshape(t.shape),
+                trees, new_leaves)
+            tvalid = tvalid.reshape(-1).at[safe_idx].set(
+                True, mode="drop").reshape(tvalid.shape)
+
+            # 3. rebuild internal levels across the whole forest
+            lvl = F >> 1
+            while lvl >= 1:
+                lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
+                rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
+                vlc = tvalid[:, 2 * lvl:4 * lvl:2]
+                vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
+                merged = combine(lc, rc)
+                node = tmap(lambda m, a, b: jnp.where(
+                    vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
+                trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
+                             trees, node)
+                tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
+                lvl >>= 1
+
+            # 4. fired-window queries (vmapped over W_cap)
+            ftrees = tmap(lambda t: t[fire_slots], trees)
+            fvalid = tvalid[fire_slots]
+            qv, qr = jax.vmap(window_query)(ftrees, fvalid, fire_starts,
+                                            fire_lens)
+            qv = qv & fire_mask
+
+            # 5. evict leaves consumed by the fired windows
+            eflat = jnp.where(evict_mask,
+                              evict_slots * NNODES + (F + evict_leaves), OOB)
+            tvalid = tvalid.reshape(-1).at[eflat].set(
+                False, mode="drop").reshape(tvalid.shape)
+
+            return trees, tvalid, qr, qv
+
+        return jax.jit(step)
+
+    # ==================================================================
+    # host control plane
+    # ==================================================================
+    def _slot(self, key) -> int:
+        s = self.slot_of_key.get(key)
+        if s is None:
+            s = self.slot_of_key[key] = len(self.slot_of_key)
+            self._out_keys_by_slot.append(key)
+            if s >= self.K_cap:
+                self._grow_keys()
+        return s
+
+    def _grow_keys(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        old = self.K_cap
+        self.K_cap *= 2
+        for name, fill in (("next_fire", 0), ("fired", 0),
+                           ("max_leaf", -1), ("count", 0)):
+            arr = getattr(self, name)
+            grown = np.full(self.K_cap, fill, dtype=arr.dtype)
+            grown[:old] = arr
+            setattr(self, name, grown)
+        if self.trees is not None:
+            self.trees = jax.tree_util.tree_map(
+                lambda t: jnp.zeros((self.K_cap,) + t.shape[1:], t.dtype)
+                .at[:old].set(t), self.trees)
+            self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool
+                                    ).at[:old].set(self.tvalid)
+        self._step_cache.clear()
+
+    def _grow_ring(self, needed_span: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        old_F = self.F
+        while needed_span >= self.F:
+            self.F *= 2
+        new_F = self.F
+        if self.trees is None:
+            return
+        old_trees, old_valid = self.trees, self.tvalid
+        self.trees = jax.tree_util.tree_map(
+            lambda t: jnp.zeros((self.K_cap, 2 * new_F), t.dtype), old_trees)
+        self.tvalid = jnp.zeros((self.K_cap, 2 * new_F), bool)
+        src_rows, src_cols, dst_cols = [], [], []
+        for _, s in self.slot_of_key.items():
+            for p in range(int(self.next_fire[s]), int(self.max_leaf[s]) + 1):
+                src_rows.append(s)
+                src_cols.append(old_F + (p % old_F))
+                dst_cols.append(new_F + (p % new_F))
+        if src_rows:
+            sr, sc, dc = (np.asarray(src_rows), np.asarray(src_cols),
+                          np.asarray(dst_cols))
+            self.trees = jax.tree_util.tree_map(
+                lambda new, old: new.at[sr, dc].set(old[sr, sc]),
+                self.trees, old_trees)
+            self.tvalid = self.tvalid.at[sr, dc].set(old_valid[sr, sc])
+        self._step_cache.clear()
+
+    def _ensure_forest(self, sample_fields) -> None:
+        if self.trees is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+        shapes = jax.eval_shape(self.op.lift, sample_fields)
+        if not isinstance(shapes, dict):
+            raise WindFlowError(f"{self.op.name}: lift must return a dict "
+                                "of columns")
+        self.trees = {name: jnp.zeros((self.K_cap, 2 * self.F), sh.dtype)
+                      for name, sh in shapes.items()}
+        self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool)
+
+    # ------------------------------------------------------------------
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        op = self.op
+        n = batch.size
+        if n == 0:
+            return
+        self._ensure_forest(batch.fields)
+        self._last_fields = {k: v[:8] for k, v in batch.fields.items()}
+        if op.key_field is not None and op.key_field in batch.fields:
+            self._key_dtype = np.dtype(batch.fields[op.key_field].dtype)
+        keys = self.batch_keys(batch)
+        slots = np.fromiter((self._slot(k) for k in keys), dtype=np.int64,
+                            count=n)
+        if op.win_type is WinType.TB:
+            leaves = batch.ts_host[:n] // op.pane_len
+        else:
+            # CB: leaf = per-key arrival index (stable within the batch)
+            leaves = np.empty(n, dtype=np.int64)
+            order0 = np.argsort(slots, kind="stable")
+            ss = slots[order0]
+            seg_start = np.r_[True, ss[1:] != ss[:-1]]
+            grp = np.cumsum(seg_start) - 1
+            first_of = np.nonzero(seg_start)[0]
+            leaves[order0] = (self.count[ss[first_of[grp]]]
+                              + np.arange(n) - first_of[grp])
+            np.add.at(self.count, slots, 1)
+        live = leaves >= self.next_fire[slots]
+        n_late = int(n - live.sum())
+        if n_late:
+            self.ignored += n_late
+            self.stats.inputs_ignored += n_late
+        if live.any():
+            span = int((leaves[live] - self.next_fire[slots[live]]).max())
+            if span >= self.F:
+                self._grow_ring(span)
+            lv_slots = slots[live]
+            np.maximum.at(self.max_leaf, lv_slots, leaves[live])
+
+        # host segmentation: lexsort by (slot, leaf) — composite integer
+        # keys would overflow with epoch-microsecond pane ids; late rows
+        # sort into one front run (slot/leaf -1) excluded from tails
+        o_slots = np.where(live, slots, -1)
+        o_leaves = np.where(live, leaves, -1)
+        order = np.lexsort((o_leaves, o_slots))
+        ssl = o_slots[order]
+        sle = o_leaves[order]
+        same = np.r_[False, (ssl[1:] == ssl[:-1]) & (sle[1:] == sle[:-1])]
+        same_prev = same
+        is_end = np.r_[~same[1:], True]
+        seg_pos_all = np.nonzero(is_end)[0]
+        seg_live = live[order][seg_pos_all]
+        seg_pos_h = seg_pos_all[seg_live]
+        n_segs = len(seg_pos_h)
+        seg_slots_h = slots[order][seg_pos_h]
+        seg_leaves_h = leaves[order][seg_pos_h]
+
+        cap = batch.capacity
+        s_cap = bucket_capacity(max(1, n_segs))
+        order_p = np.zeros(cap, dtype=np.int32)
+        order_p[:n] = order
+        same_p = np.zeros(cap, dtype=bool)
+        same_p[:n] = same_prev
+        segpos_p = np.zeros(s_cap, dtype=np.int32)
+        segpos_p[:n_segs] = seg_pos_h
+        segslot_p = np.zeros(s_cap, dtype=np.int32)
+        segslot_p[:n_segs] = seg_slots_h
+        segleaf_p = np.zeros(s_cap, dtype=np.int32)
+        segleaf_p[:n_segs] = seg_leaves_h % self.F
+        segmask_p = np.zeros(s_cap, dtype=bool)
+        segmask_p[:n_segs] = True
+
+        frontier = (max(0, batch.wm - op.lateness) // op.pane_len
+                    if op.win_type is WinType.TB else None)
+        self._run_step(batch.fields, batch.wm, cap, s_cap, order_p, same_p,
+                       segpos_p, segslot_p, segleaf_p, segmask_p, frontier)
+
+    # ------------------------------------------------------------------
+    def _fireable(self, frontier, partial: bool):
+        """Collect up to W_cap (slot, start, len, wid) fire specs."""
+        specs = []
+        for _, s in self.slot_of_key.items():
+            while len(specs) < self.W_cap:
+                start = self.next_fire[s]
+                if self.max_leaf[s] < start:
+                    break  # no data at/after this window yet
+                if partial:
+                    length = int(min(self.win_units,
+                                     self.max_leaf[s] + 1 - start))
+                elif self.op.win_type is WinType.TB:
+                    if frontier is None or start + self.win_units > frontier:
+                        break
+                    length = self.win_units
+                else:  # CB fires purely by count
+                    if self.count[s] < start + self.win_units:
+                        break
+                    length = self.win_units
+                specs.append((int(s), int(start), length, int(self.fired[s])))
+                self.next_fire[s] = start + self.slide_units
+                self.fired[s] += 1
+            if len(specs) >= self.W_cap:
+                break
+        return specs
+
+    def _run_step(self, fields, wm, cap, s_cap, order_p, same_p, segpos_p,
+                  segslot_p, segleaf_p, segmask_p, frontier,
+                  partial: bool = False) -> None:
+        import jax
+
+        first = True
+        while True:
+            specs = self._fireable(frontier, partial)
+            if not first and not specs:
+                break
+            ckey = (cap, s_cap, self.K_cap, self.F)
+            step = self._step_cache.get(ckey)
+            if step is None:
+                step = self._step_cache[ckey] = self._make_step(cap, s_cap)
+            W = self.W_cap
+            E = max(1, W * self.slide_units)
+            f_slots = np.zeros(W, dtype=np.int32)
+            f_starts = np.zeros(W, dtype=np.int32)
+            f_lens = np.zeros(W, dtype=np.int32)
+            f_mask = np.zeros(W, dtype=bool)
+            wids: List[int] = []
+            e_slots = np.zeros(E, dtype=np.int32)
+            e_leaves = np.zeros(E, dtype=np.int32)
+            e_mask = np.zeros(E, dtype=bool)
+            ei = 0
+            for i, (s, start, length, wid) in enumerate(specs):
+                f_slots[i] = s
+                f_starts[i] = start % self.F
+                f_lens[i] = length
+                f_mask[i] = True
+                wids.append(wid)
+                for p in range(start, start + self.slide_units):
+                    if p > self.max_leaf[s]:
+                        break
+                    e_slots[ei] = s
+                    e_leaves[ei] = p % self.F
+                    e_mask[ei] = True
+                    ei += 1
+            self.trees, self.tvalid, qr, qv = step(
+                fields, order_p, same_p, segpos_p, segslot_p, segleaf_p,
+                segmask_p, self.trees, self.tvalid, f_slots, f_starts,
+                f_lens, f_mask, e_slots, e_leaves, e_mask)
+            self.stats.device_programs_run += 1
+            if specs:
+                self._emit_windows(wm, specs, wids, qr, qv)
+            segmask_p = np.zeros(s_cap, dtype=bool)  # applied exactly once
+            first = False
+            if len(specs) < self.W_cap:
+                break
+
+    def _emit_windows(self, wm, specs, wids, qr, qv) -> None:
+        import jax
+
+        n_out = len(specs)
+        op = self.op
+        pad = self.W_cap - n_out
+        fields = dict(qr)
+        fields["valid"] = qv
+        fields["wid"] = jax.device_put(
+            np.asarray(wids + [0] * pad, dtype=np.int32))
+        out_keys = [self._out_keys_by_slot[s] for s, _, _, _ in specs]
+        if op.key_field is not None:
+            kd = getattr(self, "_key_dtype", np.dtype(np.int32))
+            fields[op.key_field] = jax.device_put(
+                np.asarray(list(out_keys) + [0] * pad).astype(kd))
+        out_schema = TupleSchema(
+            {name: np.dtype(v.dtype) for name, v in fields.items()})
+        ts = np.full(self.W_cap, wm, dtype=np.int64)
+        out = BatchTPU(fields, ts, n_out, out_schema, wm, out_keys)
+        self._emit_batch(out)
+
+    # ------------------------------------------------------------------
+    def _fire_dataless(self, frontier, partial: bool) -> None:
+        """Run the step program with empty segments (watermark/EOS made
+        windows fireable without new data)."""
+        if self.trees is None or self._last_fields is None:
+            return
+        cap = next(iter(self._last_fields.values())).shape[0]
+        s_cap = 8
+        self._run_step(self._last_fields, self.cur_wm, cap, s_cap,
+                       np.zeros(cap, dtype=np.int32),
+                       np.zeros(cap, dtype=bool),
+                       np.zeros(s_cap, dtype=np.int32),
+                       np.zeros(s_cap, dtype=np.int32),
+                       np.zeros(s_cap, dtype=np.int32),
+                       np.zeros(s_cap, dtype=bool), frontier, partial)
+
+    def on_punctuation(self, wm: int) -> None:
+        if self.op.win_type is WinType.TB:
+            frontier = (max(0, self.cur_wm - self.op.lateness)
+                        // self.op.pane_len)
+            self._fire_dataless(frontier, partial=False)
+        super().on_punctuation(wm)
+
+    def flush_on_termination(self) -> None:
+        self._fire_dataless(None, partial=True)

@@ -32,7 +32,7 @@ import numpy as np
 
 from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
 from ..operators.base import BasicOperator, BasicReplica
-from .batch import BatchTPU
+from .batch import BatchTPU, key_column_to_list
 from .schema import TupleSchema
 
 
@@ -66,7 +66,6 @@ class TPUReplicaBase(BasicReplica):
 
     def _emit_batch(self, batch: BatchTPU) -> None:
         self.stats.device_batches_out += 1
-        self.stats.device_programs_run += 1
         self.emitter.emit_device_batch(batch)
 
     # per-batch keys: host metadata when staged keyed, else the device key
@@ -79,8 +78,7 @@ class TPUReplicaBase(BasicReplica):
                 raise WindFlowError(
                     f"{self.op.name}: keyed TPU operator needs keyed staging "
                     "(with_key_by on the op) or a string field-name key")
-            keys = [v.item()
-                    for v in np.asarray(batch.fields[field])[:batch.size]]
+            keys = key_column_to_list(batch, field)
         return keys
 
     def batch_slots(self, batch: BatchTPU):
@@ -155,6 +153,7 @@ class MapTPUReplica(TPUReplicaBase):
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         out = self._jitted(batch.fields)
+        self.stats.device_programs_run += 1
         if not isinstance(out, dict):
             raise WindFlowError(f"{self.op.name}: Map_TPU function must "
                                 "return a dict of columns")
@@ -226,6 +225,7 @@ class StatefulMapTPUReplica(TPUReplicaBase):
         table2, outs = self._jitted(batch.fields, None,
                                     jax.device_put(slots), batch.size,
                                     self.table)
+        self.stats.device_programs_run += 1
         self.table = table2
         self._emit_batch(batch.with_fields(outs))
 
@@ -269,6 +269,7 @@ class FilterTPUReplica(TPUReplicaBase):
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         out, order, count = self._jitted(batch.fields, batch.size)
+        self.stats.device_programs_run += 1
         new_size = int(count)
         order_np = np.asarray(order)
         dropped = batch.size - new_size
@@ -349,6 +350,7 @@ class ReduceTPUReplica(TPUReplicaBase):
         import jax
         slots_dev, slot_of_key = self.batch_slots(batch)
         out_fields = self._jitted(batch.fields, slots_dev)
+        self.stats.device_programs_run += 1
         n_out = len(slot_of_key)
         if n_out == 0:
             return
