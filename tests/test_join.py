@@ -1,0 +1,138 @@
+"""Interval_Join tests (reference tests/join_tests: KP/DP x modes):
+two event-time streams joined on key within [-lower, +upper] bounds,
+compared to a host model, with randomized parallelisms."""
+
+import random
+import threading
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Interval_Join_Builder, PipeGraph,
+                          Sink_Builder, Source_Builder, TimePolicy)
+
+from common import TupleT, rand_degree
+
+N_KEYS = 4
+LEN_A, LEN_B = 50, 60
+STEP_A, STEP_B = 100, 83
+LOWER, UPPER = 120, 200
+
+
+def src_a(shipper, ctx):
+    for i in range(LEN_A):
+        ts = i * STEP_A
+        for k in range(ctx.get_replica_index(), N_KEYS,
+                       ctx.get_parallelism()):
+            shipper.push_with_timestamp(TupleT(k, 1000 + i, ts), ts)
+        shipper.set_next_watermark(ts)
+
+
+def src_b(shipper, ctx):
+    for i in range(LEN_B):
+        ts = i * STEP_B
+        for k in range(ctx.get_replica_index(), N_KEYS,
+                       ctx.get_parallelism()):
+            shipper.push_with_timestamp(TupleT(k, 2000 + i, ts), ts)
+        shipper.set_next_watermark(ts)
+
+
+def model_pairs():
+    """All (key, a_value, b_value) with ts_b in [ts_a-LOWER, ts_a+UPPER]."""
+    out = set()
+    for k in range(N_KEYS):
+        for i in range(LEN_A):
+            ta = i * STEP_A
+            for j in range(LEN_B):
+                tb = j * STEP_B
+                if ta - LOWER <= tb <= ta + UPPER:
+                    out.add((k, 1000 + i, 2000 + j))
+    return out
+
+
+class PairCollector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pairs = []
+
+    def sink(self, r):
+        if r is not None:
+            with self._lock:
+                self.pairs.append(r)
+
+
+def run_join(mode, kp, rng):
+    coll = PairCollector()
+    graph = PipeGraph("join", mode, TimePolicy.EVENT_TIME)
+    a = (Source_Builder(src_a).with_parallelism(rand_degree(rng)).build())
+    b = (Source_Builder(src_b).with_parallelism(rand_degree(rng)).build())
+    jb = (Interval_Join_Builder(
+            lambda x, y: (x.key, x.value, y.value))
+          .with_key_by(lambda t: t.key)
+          .with_boundaries(LOWER, UPPER)
+          .with_parallelism(rand_degree(rng)))
+    jb = jb.with_kp_mode() if kp else jb.with_dp_mode()
+    join = jb.build()
+    mpa = graph.add_source(a)
+    mpb = graph.add_source(b)
+    mpa.merge(mpb).add(join).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    return coll
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_interval_join_kp(mode):
+    rng = random.Random(3)
+    expected = model_pairs()
+    for r in range(3):
+        coll = run_join(mode, kp=True, rng=rng)
+        got = set(coll.pairs)
+        assert len(coll.pairs) == len(got), "duplicate join results"
+        assert got == expected, f"run {r}: {len(got)} vs {len(expected)}"
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_interval_join_dp(mode):
+    rng = random.Random(5)
+    expected = model_pairs()
+    for r in range(3):
+        coll = run_join(mode, kp=False, rng=rng)
+        got = set(coll.pairs)
+        assert len(coll.pairs) == len(got), "duplicate join results"
+        assert got == expected, f"run {r}: {len(got)} vs {len(expected)}"
+
+
+def test_join_requires_two_pipes():
+    from windflow_tpu import WindFlowError
+    graph = PipeGraph("join_bad", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    a = Source_Builder(src_a).build()
+    join = (Interval_Join_Builder(lambda x, y: None)
+            .with_key_by(lambda t: t.key).with_boundaries(0, 0).build())
+    with pytest.raises(WindFlowError):
+        graph.add_source(a).add(join)
+
+
+def test_join_asymmetric_bounds():
+    """lower=0: only B tuples at/after the A tuple match."""
+    coll = PairCollector()
+    graph = PipeGraph("join_asym", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def sa(sh, ctx):
+        sh.push_with_timestamp(TupleT(0, 1, 1000), 1000)
+        sh.set_next_watermark(1000)
+
+    def sb(sh, ctx):
+        for ts, v in [(900, 10), (1000, 11), (1100, 12), (1300, 13)]:
+            sh.push_with_timestamp(TupleT(0, v, ts), ts)
+            sh.set_next_watermark(ts)
+
+    join = (Interval_Join_Builder(lambda x, y: (x.value, y.value))
+            .with_key_by(lambda t: t.key).with_boundaries(0, 200).build())
+    graph.add_source(Source_Builder(sa).build()) \
+        .merge(graph.add_source(Source_Builder(sb).build())) \
+        .add(join).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert set(coll.pairs) == {(1, 11), (1, 12)}

@@ -1,0 +1,167 @@
+"""Kafka connector tests (reference tests/kafka_tests, runnable in-process
+via the memory broker) and monitoring protocol tests (miscellanea tracing
+tests analog)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from windflow_tpu import (Map_Builder, PipeGraph, Sink_Builder,
+                          Source_Builder)
+from windflow_tpu.kafka import (Kafka_Sink_Builder, Kafka_Source_Builder,
+                                MemoryBroker)
+from windflow_tpu.monitoring.monitor import MonitoringServer
+
+from common import GlobalSum, TupleT, make_ingress_source, make_sum_sink
+
+
+@pytest.fixture(autouse=True)
+def fresh_broker():
+    MemoryBroker.reset()
+    yield
+    MemoryBroker.reset()
+
+
+def fill_topic(broker_name, topic, n, n_partitions=4):
+    b = MemoryBroker.get(broker_name, n_partitions)
+    for i in range(n):
+        b.produce(topic, {"k": i % 5, "v": i + 1}, key=i % 5)
+    return b
+
+
+def test_kafka_source_consumes_all():
+    fill_topic("b1", "events", 200)
+    acc = GlobalSum()
+    graph = PipeGraph("ksrc")
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False  # idle: topic drained
+        shipper.push(TupleT(msg.payload["k"], msg.payload["v"]))
+        return True
+
+    src = (Kafka_Source_Builder(deser).with_brokers("memory://b1")
+           .with_topics("events").with_group_id("g1")
+           .with_idleness(50).build())
+    graph.add_source(src).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    assert acc.count == 200
+    assert acc.value == sum(range(1, 201))
+
+
+def test_kafka_source_consumer_group_partitions():
+    """Two replicas split the partitions; union of consumption = topic."""
+    fill_topic("b2", "events", 120)
+    acc = GlobalSum()
+    graph = PipeGraph("kgrp")
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        shipper.push(TupleT(msg.payload["k"], msg.payload["v"]))
+        return True
+
+    src = (Kafka_Source_Builder(deser).with_brokers("memory://b2")
+           .with_topics("events").with_group_id("g1")
+           .with_idleness(50).with_parallelism(2).build())
+    graph.add_source(src).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    assert acc.count == 120
+    assert acc.value == sum(range(1, 121))
+
+
+def test_kafka_source_explicit_offsets_replay():
+    """withOffsets: start positions replay a suffix of each partition."""
+    b = fill_topic("b3", "events", 40, n_partitions=2)
+    total_all = sum(range(1, 41))
+    # skip the first 5 messages of each partition
+    skipped = 0
+    for p in range(2):
+        for off in range(5):
+            skipped += b.poll("events", p, off).payload["v"]
+    acc = GlobalSum()
+    graph = PipeGraph("koff")
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        shipper.push(TupleT(0, msg.payload["v"]))
+        return True
+
+    src = (Kafka_Source_Builder(deser).with_brokers("memory://b3")
+           .with_topics("events")
+           .with_offsets({("events", 0): 5, ("events", 1): 5})
+           .with_idleness(50).build())
+    graph.add_source(src).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    assert acc.value == total_all - skipped
+
+
+def test_kafka_sink_roundtrip():
+    """Pipeline -> Kafka_Sink -> broker -> second pipeline via Kafka_Source."""
+    acc = GlobalSum()
+    g1 = PipeGraph("to_kafka")
+    src = Source_Builder(make_ingress_source(3, 30)).build()
+    sink = (Kafka_Sink_Builder(
+                lambda t: ("out", t.key, {"k": t.key, "v": t.value}))
+            .with_brokers("memory://b4").build())
+    g1.add_source(src).add(Map_Builder(lambda t: t).build()).add(sink)
+    g1.run()
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        shipper.push(TupleT(msg.payload["k"], msg.payload["v"]))
+        return True
+
+    g2 = PipeGraph("from_kafka")
+    ksrc = (Kafka_Source_Builder(deser).with_brokers("memory://b4")
+            .with_topics("out").with_idleness(50).build())
+    g2.add_source(ksrc).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    g2.run()
+    assert acc.count == 3 * 30
+    assert acc.value == 3 * sum(range(1, 31))
+
+
+def test_kafka_requires_client_for_real_brokers():
+    from windflow_tpu import WindFlowError
+    with pytest.raises(WindFlowError, match="client"):
+        (Kafka_Source_Builder(lambda m, s: False)
+         .with_brokers("localhost:9092").with_topics("t").build())
+
+
+# ---------------------------------------------------------------------------
+# monitoring
+# ---------------------------------------------------------------------------
+def test_monitoring_reports_over_tcp(monkeypatch):
+    server = MonitoringServer()
+    monkeypatch.setenv("WF_TRACING_ENABLED", "1")
+    monkeypatch.setenv("WF_DASHBOARD_MACHINE", server.host)
+    monkeypatch.setenv("WF_DASHBOARD_PORT", str(server.port))
+    monkeypatch.setenv("WF_LOG_DIR", "/tmp/wf_test_logs")
+    acc = GlobalSum()
+    graph = PipeGraph("traced")
+    src = Source_Builder(make_ingress_source(2, 50)).build()
+    graph.add_source(src).add(Map_Builder(lambda t: t).build()).add_sink(
+        Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        snap = server.snapshot()
+        if "traced" in snap["reports"] and "traced" in snap["diagrams"]:
+            break
+        time.sleep(0.05)
+    snap = server.snapshot()
+    server.close()
+    assert "traced" in snap["diagrams"]
+    assert "->" in snap["diagrams"]["traced"]
+    stats = snap["reports"]["traced"]
+    assert stats["PipeGraph_name"] == "traced"
+    assert any(o["kind"] == "Map" for o in stats["Operators"])
+    # the stats log dump also happened (wait_end with tracing enabled)
+    assert os.path.exists("/tmp/wf_test_logs/traced_stats.json")
+    with open("/tmp/wf_test_logs/traced_stats.json") as f:
+        dumped = json.load(f)
+    assert dumped["Threads"] == graph.get_num_threads()

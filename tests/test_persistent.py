@@ -1,0 +1,148 @@
+"""Persistent operator tests (reference tests/rocksdb_tests): keyed state
+in the embedded DB survives cache pressure and is complete at EOS;
+P_Keyed_Windows matches Keyed_Windows exactly."""
+
+import os
+import tempfile
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder, PipeGraph,
+                          Sink_Builder, Source_Builder, TimePolicy)
+from windflow_tpu.persistent import (DBHandle, LRUStore, P_Keyed_Windows_Builder,
+                                     P_Map_Builder, P_Reduce_Builder,
+                                     P_Sink_Builder)
+
+from common import GlobalSum, TupleT, WinCollector, expected_windows, \
+    make_ingress_source, make_sum_sink
+
+
+@pytest.fixture()
+def db_dir(tmp_path):
+    return str(tmp_path)
+
+
+def test_db_handle_roundtrip(db_dir):
+    db = DBHandle("t1", db_dir=db_dir)
+    db.put(("k", 1), {"a": [1, 2, 3]})
+    db.put("x", 42)
+    assert db.get(("k", 1)) == {"a": [1, 2, 3]}
+    assert db.get("missing", "d") == "d"
+    assert db.contains("x") and not db.contains("y")
+    assert len(db) == 2
+    db.delete("x")
+    assert len(db) == 1
+    db.close()
+    db2 = DBHandle("t1", db_dir=db_dir)  # durability across handles
+    assert db2.get(("k", 1)) == {"a": [1, 2, 3]}
+    db2.close()
+
+
+def test_lru_store_spill_and_reload(db_dir):
+    db = DBHandle("t2", db_dir=db_dir)
+    store = LRUStore(db, capacity=2)
+    for i in range(10):
+        store[i] = [i] * 3
+    assert store[0] == [0, 0, 0]  # reloaded from the DB after eviction
+    assert len(store) == 10
+    assert sorted(store) == list(range(10))
+    store.flush()
+    assert sorted(k for k in db.keys()) == list(range(10))
+    db.close()
+
+
+def test_p_map_running_state(db_dir):
+    """Per-key counter persisted with a 2-entry cache (constant spills)."""
+    acc = GlobalSum()
+    graph = PipeGraph("pmap")
+    src = Source_Builder(make_ingress_source(8, 30)).with_parallelism(2).build()
+
+    def number(t, state):
+        state["n"] += 1
+        return TupleT(t.key, state["n"]), state
+
+    pm = (P_Map_Builder(number).with_key_by(lambda t: t.key)
+          .with_initial_state({"n": 0}).with_db_path(db_dir)
+          .with_cache_capacity(2).with_parallelism(2).build())
+    graph.add_source(src).add(pm).add_sink(
+        Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    # per key the outputs are 1..30
+    assert acc.value == 8 * sum(range(1, 31))
+
+
+def test_p_reduce_matches_reduce(db_dir):
+    from windflow_tpu import Reduce_Builder
+    results = {}
+    for variant in ("memory", "persistent"):
+        acc = GlobalSum()
+        graph = PipeGraph(f"pr_{variant}")
+        src = Source_Builder(make_ingress_source(5, 40)).build()
+
+        def add(t, state):
+            state.value += t.value
+            state.key = t.key
+            return state
+
+        if variant == "memory":
+            op = (Reduce_Builder(add).with_key_by(lambda t: t.key)
+                  .with_initial_state(TupleT(0, 0)).build())
+        else:
+            op = (P_Reduce_Builder(add).with_key_by(lambda t: t.key)
+                  .with_initial_state(TupleT(0, 0)).with_db_path(db_dir)
+                  .with_cache_capacity(2).build())
+        graph.add_source(src).add(op).add_sink(
+            Sink_Builder(make_sum_sink(acc)).build())
+        graph.run()
+        results[variant] = (acc.value, acc.count)
+    assert results["memory"] == results["persistent"]
+
+
+def test_p_keyed_windows_matches_keyed_windows(db_dir):
+    """Same stream through in-memory and persistent keyed windows (tiny
+    cache to force spills) must produce identical window results."""
+    from test_windows import make_keyed_event_source, model_seqs
+    expected = expected_windows(model_seqs(6, 50), 1000, 400, False,
+                                lambda vs: sum(vs))
+    results = {}
+    for variant in ("memory", "persistent"):
+        coll = WinCollector()
+        graph = PipeGraph(f"pkw_{variant}", ExecutionMode.DEFAULT,
+                          TimePolicy.EVENT_TIME)
+        src = Source_Builder(make_keyed_event_source(6, 50)).build()
+        if variant == "memory":
+            op = (Keyed_Windows_Builder(lambda ws: sum(w.value for w in ws))
+                  .with_key_by(lambda t: t.key)
+                  .with_tb_windows(1000, 400).with_parallelism(2).build())
+        else:
+            op = (P_Keyed_Windows_Builder(lambda ws: sum(w.value for w in ws))
+                  .with_key_by(lambda t: t.key)
+                  .with_tb_windows(1000, 400).with_parallelism(2)
+                  .with_db_path(db_dir).with_cache_capacity(2).build())
+        graph.add_source(src).add(op).add_sink(
+            Sink_Builder(coll.sink).build())
+        graph.run()
+        results[variant] = coll.results
+    assert results["memory"] == expected
+    assert results["persistent"] == expected
+
+
+def test_p_sink_final_state(db_dir):
+    graph = PipeGraph("psink")
+    src = Source_Builder(make_ingress_source(4, 25)).build()
+
+    def collect(t, state):
+        if t is not None:
+            state["sum"] += t.value
+        return state
+
+    ps = (P_Sink_Builder(collect).with_key_by(lambda t: t.key)
+          .with_initial_state({"sum": 0}).with_db_path(db_dir)
+          .with_cache_capacity(1).build())
+    graph.add_source(src).add(ps)
+    graph.run()
+    # EOS flushed the cache: the DB holds the complete final keyed state
+    db = DBHandle("p_sink_r0", db_dir=db_dir)
+    state = dict(db.items())
+    db.close()
+    assert state == {k: {"sum": sum(range(1, 26))} for k in range(4)}

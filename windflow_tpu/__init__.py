@@ -11,12 +11,16 @@ the FlatFAT sliding-window tree is a batched segment tree in HBM
 reference lacks) shards keyed state over a ``jax.sharding.Mesh``.
 
 Import layering: ``import windflow_tpu`` pulls only the CPU plane (no jax);
-``windflow_tpu.tpu`` loads the device plane lazily.
+``windflow_tpu.tpu`` loads the device plane lazily. Subpackages:
+``windflow_tpu.tpu`` (device operators), ``windflow_tpu.parallel``
+(multi-chip mesh), ``windflow_tpu.persistent`` (out-of-core keyed state),
+``windflow_tpu.kafka`` (connectors), ``windflow_tpu.monitoring``.
 """
 
 from .basic import (ExecutionMode, JoinMode, RoutingMode, TimePolicy,
                     WindFlowError, WinType)
 from .builders import (Ffat_Windows_Builder, Filter_Builder,
+                       Interval_Join_Builder,
                        FlatMap_Builder, Keyed_Windows_Builder, Map_Builder,
                        MapReduce_Windows_Builder, Paned_Windows_Builder,
                        Parallel_Windows_Builder, Reduce_Builder, Sink_Builder,
@@ -25,6 +29,7 @@ from .context import LocalStorage, RuntimeContext
 from .message import Batch, Single
 from .operators.basic_ops import (Filter, FlatMap, Map, Reduce, Shipper, Sink)
 from .operators.ffat import Ffat_Windows
+from .operators.join import Interval_Join
 from .operators.flatfat import FlatFAT
 from .operators.window_engine import WinResult
 from .operators.windows import (Keyed_Windows, MapReduce_Windows,
@@ -49,6 +54,6 @@ __all__ = [
     "MapReduce_Windows", "Ffat_Windows", "FlatFAT", "WinResult",
     "Keyed_Windows_Builder", "Parallel_Windows_Builder",
     "Paned_Windows_Builder", "MapReduce_Windows_Builder",
-    "Ffat_Windows_Builder",
+    "Ffat_Windows_Builder", "Interval_Join", "Interval_Join_Builder",
     "__version__",
 ]

@@ -304,3 +304,49 @@ class Ffat_Windows_Builder(_WindowedBuilder):
             self._func, self._combine, self._key_extractor, self._win_len,
             self._slide_len, self._win_type, self._lateness, self._name,
             self._parallelism, self._output_batch_size))
+
+
+# ---------------------------------------------------------------------------
+# Interval_Join builder (wf/builders.hpp:1480-1538: withBoundaries,
+# withKPMode, withDPMode)
+# ---------------------------------------------------------------------------
+from .basic import JoinMode  # noqa: E402
+from .operators.join import Interval_Join  # noqa: E402
+
+
+class Interval_Join_Builder(BasicBuilder):
+    _default_name = "interval_join"
+
+    def __init__(self, join_func):
+        super().__init__(join_func)
+        self._key_extractor = None
+        self._lower = None
+        self._upper = None
+        self._mode = JoinMode.KP
+
+    def with_key_by(self, key_extractor):
+        self._key_extractor = key_extractor
+        return self
+
+    def with_boundaries(self, lower_usec: int, upper_usec: int):
+        self._lower, self._upper = lower_usec, upper_usec
+        return self
+
+    def with_kp_mode(self):
+        self._mode = JoinMode.KP
+        return self
+
+    def with_dp_mode(self):
+        self._mode = JoinMode.DP
+        return self
+
+    def build(self) -> Interval_Join:
+        if self._key_extractor is None:
+            raise WindFlowError("Interval_Join_Builder: withKeyBy mandatory")
+        if self._lower is None:
+            raise WindFlowError("Interval_Join_Builder: withBoundaries "
+                                "mandatory")
+        return self._finish(Interval_Join(
+            self._func, self._key_extractor, self._lower, self._upper,
+            self._mode, self._name, self._parallelism,
+            self._output_batch_size))

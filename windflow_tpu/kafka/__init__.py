@@ -1,0 +1,5 @@
+from .connectors import Kafka_Sink, Kafka_Source, MemoryBroker
+from .builders_kafka import Kafka_Sink_Builder, Kafka_Source_Builder
+
+__all__ = ["Kafka_Source", "Kafka_Sink", "MemoryBroker",
+           "Kafka_Source_Builder", "Kafka_Sink_Builder"]

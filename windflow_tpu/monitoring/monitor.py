@@ -1,0 +1,135 @@
+"""Monitoring thread + collection server.
+
+Parity: ``wf/monitoring.hpp:161-295`` — with WF_TRACING_ENABLED the
+reference spawns one thread per PipeGraph that connects over raw TCP to the
+Java dashboard, sends the graph diagram once, then 1 Hz JSON stat reports.
+Here the protocol is newline-delimited JSON over TCP (machine/port from
+WF_DASHBOARD_MACHINE / WF_DASHBOARD_PORT like the reference's macros):
+
+    {"type": "diagram", "graph": ..., "dot": ...}
+    {"type": "report", "graph": ..., "stats": {...}}    (1 Hz)
+
+``MonitoringServer`` is the in-tree collector (the dashboard-server
+analog): it accepts those connections and keeps the latest report per
+graph, queryable in-process or dumpable to JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class MonitoringThread(threading.Thread):
+    def __init__(self, graph, machine: Optional[str] = None,
+                 port: Optional[int] = None, period_sec: float = 1.0) -> None:
+        super().__init__(name=f"monitor:{graph.name}", daemon=True)
+        self.graph = graph
+        self.machine = machine or os.environ.get("WF_DASHBOARD_MACHINE",
+                                                 "127.0.0.1")
+        self.port = int(port or os.environ.get("WF_DASHBOARD_PORT", "20300"))
+        self.period = period_sec
+        # NB: threading.Thread has a private _stop METHOD; don't shadow it
+        self._stop_evt = threading.Event()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def run(self) -> None:
+        try:
+            sock = socket.create_connection((self.machine, self.port),
+                                            timeout=2.0)
+        except OSError:
+            return  # dashboard absent: tracing continues via local logs
+        try:
+            f = sock.makefile("w")
+            f.write(json.dumps({"type": "diagram", "graph": self.graph.name,
+                                "dot": self.graph.to_dot()}) + "\n")
+            f.flush()
+            while not self._stop_evt.wait(self.period):
+                f.write(json.dumps({"type": "report",
+                                    "graph": self.graph.name,
+                                    "stats": self.graph.get_stats()}) + "\n")
+                f.flush()
+            f.write(json.dumps({"type": "report", "graph": self.graph.name,
+                                "final": True,
+                                "stats": self.graph.get_stats()}) + "\n")
+            f.flush()
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class MonitoringServer:
+    """Accepts monitoring connections; keeps the latest diagram/report per
+    graph (the dashboard-server analog, ``dashboard/Server`` in the
+    reference)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()
+        self.diagrams: Dict[str, str] = {}
+        self.reports: Dict[str, Any] = {}
+        self.n_reports = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._srv.settimeout(0.2)
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            f = conn.makefile("r")
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                with self._lock:
+                    if msg.get("type") == "diagram":
+                        self.diagrams[msg["graph"]] = msg["dot"]
+                    elif msg.get("type") == "report":
+                        self.reports[msg["graph"]] = msg["stats"]
+                        self.n_reports += 1
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"diagrams": dict(self.diagrams),
+                    "reports": dict(self.reports),
+                    "n_reports": self.n_reports}
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass

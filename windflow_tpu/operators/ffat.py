@@ -118,6 +118,13 @@ class FfatReplica(BasicReplica):
                 self._fire(key, ks, wm, ts)
         else:
             pane_id = ts // op.pane_len
+            if ks.count == 0:
+                # first tuple of this key: align the ring to the first
+                # window that can contain it (epoch-scale ts safety)
+                w0 = max(0, (pane_id - self._win_units) // self._slide_units + 1)
+                ks.next_pane_to_push = w0 * self._slide_units
+                ks.next_gwid = w0
+            ks.count += 1
             if pane_id < ks.next_pane_to_push:
                 self.ignored += 1  # behind the consumed-pane frontier
                 return

@@ -142,11 +142,19 @@ class WindowEngine:
         if kd is None:
             kd = self.key_map[key] = _KeyDesc(
                 next_res_id=(self.map_index if self.role is WinRole.MAP else 0))
+        is_new_key = kd.next_input_id == 0
         ident = kd.next_input_id
         kd.next_input_id += 1
         index = ident if self.win_type is WinType.CB else ts
         first_gwid = self._first_gwid(key)
         initial = first_gwid * (self.slide_local // self.num_inner)
+        if is_new_key and self.win_type is WinType.TB:
+            # a key first seen at a large timestamp starts at the first
+            # window that can contain it — creating (and empty-firing) every
+            # window since the time origin would blow up with epoch-scale
+            # timestamps. Global window ids stay aligned.
+            rel = index - initial
+            kd.next_lwid = max(0, (rel - self.win_len) // self.slide_local + 1)
         # late-tuple guard: before the first still-open window => ignored
         min_boundary = (self.win_len + kd.last_fired_lwid * self.slide_local
                         if kd.last_fired_lwid >= 0 else 0)

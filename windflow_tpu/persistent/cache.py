@@ -1,0 +1,121 @@
+"""Hot-state caches for persistent operators.
+
+Parity: ``wf/persistent/cache/*.hpp`` — the reference keeps an LRU/LFU
+cache of hot window buffers in front of RocksDB
+(``p_window_replica.hpp:121``). ``LRUStore`` is a MutableMapping that the
+window engine / keyed operators use directly: hot entries live in memory,
+evictions spill to the DBHandle, lookups fall back to it.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Iterator, MutableMapping
+
+from .db_handle import DBHandle
+
+_MISSING = object()
+
+
+class LRUCache:
+    """Plain bounded LRU with an eviction callback."""
+
+    def __init__(self, capacity: int, on_evict=None) -> None:
+        self.capacity = max(1, capacity)
+        self.on_evict = on_evict
+        self._d: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, default=None):
+        v = self._d.get(key, _MISSING)
+        if v is _MISSING:
+            self.misses += 1
+            return default
+        self.hits += 1
+        self._d.move_to_end(key)
+        return v
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            k, v = self._d.popitem(last=False)
+            if self.on_evict is not None:
+                self.on_evict(k, v)
+
+    def pop(self, key, default=None):
+        return self._d.pop(key, default)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def items(self):
+        return self._d.items()
+
+
+class LRUStore(MutableMapping):
+    """Dict-like keyed-state store: LRU cache over a DBHandle. Satisfies
+    the access pattern of the window engine and keyed operators
+    (get/setitem/items), so persistent variants reuse the exact same
+    processing logic with out-of-core state."""
+
+    def __init__(self, db: DBHandle, capacity: int = 1024) -> None:
+        self.db = db
+        self.cache = LRUCache(capacity, on_evict=self._spill)
+
+    def _spill(self, key, value) -> None:
+        self.db.put(key, value)
+
+    # -- MutableMapping ----------------------------------------------------
+    def __getitem__(self, key):
+        v = self.cache.get(key, _MISSING)
+        if v is not _MISSING:
+            return v
+        v = self.db.get(key, _MISSING)
+        if v is _MISSING:
+            raise KeyError(key)
+        self.cache.put(key, v)
+        return v
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __setitem__(self, key, value) -> None:
+        self.cache.put(key, value)
+
+    def __delitem__(self, key) -> None:
+        self.cache.pop(key, None)
+        self.db.delete(key)
+
+    def __iter__(self) -> Iterator:
+        seen = set()
+        for k in list(self.cache._d.keys()):
+            seen.add(k)
+            yield k
+        for k in self.db.keys():
+            if k not in seen:
+                yield k
+
+    def __len__(self) -> int:
+        n = len(self.cache)
+        for k in self.db.keys():
+            if k not in self.cache:
+                n += 1
+        return n
+
+    def items(self):
+        for k in list(self):
+            yield k, self[k]
+
+    def flush(self) -> None:
+        """Spill every cached entry so the DB is complete (EOS/checkpoint)."""
+        for k, v in list(self.cache.items()):
+            self.db.put(k, v)
+        self.db.commit()

@@ -1,0 +1,90 @@
+"""DBHandle: durable keyed state for persistent operators.
+
+Parity: ``wf/persistent/db_handle.hpp:54-345`` — the reference opens one
+RocksDB instance per replica (path per pid, L87) and moves user state
+through user-provided serialize/deserialize functions keyed by the
+serialized stream key. RocksDB is not in this image; sqlite3 (stdlib)
+provides the same embedded ordered-KV capability: one database file per
+replica, a single ``kv`` table, WAL mode for concurrent reader safety.
+
+Serialization defaults to pickle; users can supply ``serialize`` /
+``deserialize`` callables exactly like the reference builders do
+(``wf/persistent/builders_rocksdb.hpp``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sqlite3
+import tempfile
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+
+def default_db_dir() -> str:
+    """Reference: path per pid (``db_handle.hpp:87``)."""
+    d = os.environ.get("WF_DB_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    f"windflow_tpu_db_{os.getpid()}"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class DBHandle:
+    def __init__(self, name: str,
+                 serialize: Optional[Callable[[Any], bytes]] = None,
+                 deserialize: Optional[Callable[[bytes], Any]] = None,
+                 db_dir: Optional[str] = None,
+                 shared: bool = False) -> None:
+        self.path = os.path.join(db_dir or default_db_dir(), f"{name}.db")
+        self._ser = serialize or pickle.dumps
+        self._de = deserialize or pickle.loads
+        # handles are built on the main thread and then used by exactly one
+        # worker thread; sqlite's same-thread guard must not apply
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)")
+        self._conn.commit()
+
+    def _kbytes(self, key: Any) -> bytes:
+        return pickle.dumps(key)
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        row = self._conn.execute("SELECT v FROM kv WHERE k = ?",
+                                 (self._kbytes(key),)).fetchone()
+        if row is None:
+            return default
+        return self._de(row[0])
+
+    def put(self, key: Any, value: Any) -> None:
+        self._conn.execute(
+            "INSERT INTO kv (k, v) VALUES (?, ?) "
+            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+            (self._kbytes(key), self._ser(value)))
+
+    def delete(self, key: Any) -> None:
+        self._conn.execute("DELETE FROM kv WHERE k = ?", (self._kbytes(key),))
+
+    def contains(self, key: Any) -> bool:
+        return self._conn.execute("SELECT 1 FROM kv WHERE k = ?",
+                                  (self._kbytes(key),)).fetchone() is not None
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        for k, v in self._conn.execute("SELECT k, v FROM kv"):
+            yield pickle.loads(k), self._de(v)
+
+    def keys(self):
+        for k, in self._conn.execute("SELECT k FROM kv"):
+            yield pickle.loads(k)
+
+    def __len__(self) -> int:
+        return self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.commit()
+        self._conn.close()

@@ -1,0 +1,200 @@
+"""Persistent basic operators: keyed state that survives in an embedded DB.
+
+Parity: ``wf/persistent/`` p_filter/p_map/p_flatmap/p_reduce/p_sink — the
+same operator logic as the in-memory versions, but each tuple's processing
+reads/modifies/writes its key's state through a DBHandle fronted by an LRU
+cache. Functor signatures follow the reference's persistent forms: the
+user function receives (tuple, state) and returns (result, new_state) —
+or mutates the state object and returns just the result. ``initial_state``
+is deep-copied per key on first sight.
+
+State durability: each replica owns one sqlite file named
+``<graph>_<op>_r<idx>``; at EOS the cache is flushed so the database holds
+the complete final keyed state (the reference's closest analog to
+checkpointing, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Optional
+
+from ..basic import OpType, RoutingMode, WindFlowError
+from ..operators.base import BasicOperator, BasicReplica, arity
+from ..operators.basic_ops import Shipper
+from .cache import LRUStore
+from .db_handle import DBHandle
+
+
+class _PersistentOperator(BasicOperator):
+    def __init__(self, func: Callable, key_extractor, initial_state: Any,
+                 name: str, parallelism: int, output_batch_size: int,
+                 db_dir: Optional[str] = None, cache_capacity: int = 1024,
+                 serialize=None, deserialize=None,
+                 input_routing: RoutingMode = RoutingMode.KEYBY) -> None:
+        if key_extractor is None:
+            raise WindFlowError(f"{name}: persistent operators require a "
+                                "key extractor")
+        super().__init__(name, parallelism, input_routing, key_extractor,
+                         output_batch_size)
+        self.func = func
+        self.initial_state = initial_state
+        self.db_dir = db_dir
+        self.cache_capacity = cache_capacity
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self._riched = arity(func) >= 3
+
+    @property
+    def is_chainable(self) -> bool:
+        return False
+
+    replica_cls: type = None
+
+    def build_replicas(self) -> None:
+        self.replicas = [self.replica_cls(self, i)
+                         for i in range(self.parallelism)]
+
+
+class _PersistentReplica(BasicReplica):
+    def __init__(self, op: _PersistentOperator, idx: int) -> None:
+        super().__init__(op, idx)
+        self.db = DBHandle(f"{op.name}_r{idx}", op.serialize, op.deserialize,
+                           op.db_dir)
+        self.state = LRUStore(self.db, op.cache_capacity)
+
+    def _get_state(self, key):
+        try:
+            return self.state[key]
+        except KeyError:
+            return copy.deepcopy(self.op.initial_state)
+
+    def _call(self, *args):
+        if self.op._riched:
+            return self.op.func(*args, self.context)
+        return self.op.func(*args)
+
+    def flush_on_termination(self) -> None:
+        self.state.flush()
+
+    def terminate(self) -> None:
+        super().terminate()
+        self.db.close()
+
+
+# ---------------------------------------------------------------------------
+class P_Map(_PersistentOperator):
+    """func(tuple, state) -> (mapped, new_state) (or mutate state and
+    return mapped)."""
+
+
+class PMapReplica(_PersistentReplica):
+    def process(self, payload, ts, wm, tag):
+        key = self.op.key_extractor(payload)
+        st = self._get_state(key)
+        out = self._call(payload, st)
+        if isinstance(out, tuple) and len(out) == 2:
+            result, st = out
+        else:
+            result = out
+        self.state[key] = st
+        if result is not None:
+            self.emitter.emit(result, ts, wm)
+
+
+P_Map.replica_cls = PMapReplica
+
+
+class P_Filter(_PersistentOperator):
+    """func(tuple, state) -> (keep, new_state) (or mutate state, return
+    keep)."""
+
+
+class PFilterReplica(_PersistentReplica):
+    def process(self, payload, ts, wm, tag):
+        key = self.op.key_extractor(payload)
+        st = self._get_state(key)
+        out = self._call(payload, st)
+        if isinstance(out, tuple) and len(out) == 2:
+            keep, st = out
+        else:
+            keep = out
+        self.state[key] = st
+        if keep:
+            self.emitter.emit(payload, ts, wm)
+        else:
+            self.stats.inputs_ignored += 1
+
+
+P_Filter.replica_cls = PFilterReplica
+
+
+class P_FlatMap(_PersistentOperator):
+    """func(tuple, shipper, state) -> new_state (or mutate state)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._riched = arity(self.func) >= 4
+
+
+class PFlatMapReplica(_PersistentReplica):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self.shipper = Shipper(self)
+
+    def process(self, payload, ts, wm, tag):
+        key = self.op.key_extractor(payload)
+        st = self._get_state(key)
+        self.shipper._ts = ts
+        self.shipper._wm = wm
+        out = self._call(payload, self.shipper, st)
+        self.state[key] = out if out is not None else st
+
+
+P_FlatMap.replica_cls = PFlatMapReplica
+
+
+class P_Reduce(_PersistentOperator):
+    """Keyed running reduce with durable state: func(tuple, state) ->
+    new_state; the updated state is emitted after each update (like
+    Reduce)."""
+
+
+class PReduceReplica(_PersistentReplica):
+    def process(self, payload, ts, wm, tag):
+        key = self.op.key_extractor(payload)
+        st = self._get_state(key)
+        out = self._call(payload, st)
+        if out is not None:
+            st = out
+        self.state[key] = st
+        self.emitter.emit(copy.copy(st), ts, wm)
+
+
+P_Reduce.replica_cls = PReduceReplica
+
+
+class P_Sink(_PersistentOperator):
+    """func(Optional[tuple], state) -> new_state per tuple; None at EOS."""
+
+    op_type = OpType.SINK
+
+
+class PSinkReplica(_PersistentReplica):
+    def process(self, payload, ts, wm, tag):
+        key = self.op.key_extractor(payload)
+        st = self._get_state(key)
+        out = self._call(payload, st)
+        self.state[key] = out if out is not None else st
+
+    def flush_on_termination(self) -> None:
+        # EOS marker per key (the in-memory Sink gets one func(None) call;
+        # the keyed persistent sink finalizes every key's state)
+        for key, st in list(self.state.items()):
+            out = self._call(None, st)
+            if out is not None:
+                self.state[key] = out
+        super().flush_on_termination()
+
+
+P_Sink.replica_cls = PSinkReplica

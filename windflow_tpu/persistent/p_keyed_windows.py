@@ -1,0 +1,54 @@
+"""P_Keyed_Windows: keyed windows with out-of-core per-key window state.
+
+Parity: ``wf/persistent/p_window_replica.hpp:69-659`` — the reference
+buffers window content as fragmented lists in RocksDB with an LRU cache of
+hot window buffers. Here the SAME WindowEngine as Keyed_Windows runs with
+its per-key descriptor map replaced by an ``LRUStore``: hot keys stay in
+memory, cold key descriptors (open windows + archives) spill to the
+replica's sqlite file and reload on access. Window semantics are therefore
+identical to Keyed_Windows by construction; only state residency differs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..basic import WinType
+from ..operators.windows import Keyed_Windows, _WindowReplica
+from .cache import LRUStore
+from .db_handle import DBHandle
+
+
+class P_Keyed_Windows(Keyed_Windows):
+    def __init__(self, win_func: Callable, key_extractor, win_len: int,
+                 slide_len: int, win_type: WinType = WinType.CB,
+                 lateness: int = 0, incremental: bool = False,
+                 initial_value: Any = None, name: str = "p_keyed_windows",
+                 parallelism: int = 1, output_batch_size: int = 0,
+                 db_dir: Optional[str] = None, cache_capacity: int = 256,
+                 serialize=None, deserialize=None) -> None:
+        super().__init__(win_func, key_extractor, win_len, slide_len,
+                         win_type, lateness, incremental, initial_value,
+                         name, parallelism, output_batch_size)
+        self.db_dir = db_dir
+        self.cache_capacity = cache_capacity
+        self.serialize = serialize
+        self.deserialize = deserialize
+
+    def build_replicas(self) -> None:
+        self.replicas = [PKeyedWindowsReplica(self, i)
+                         for i in range(self.parallelism)]
+
+
+class PKeyedWindowsReplica(_WindowReplica):
+    def __init__(self, op: P_Keyed_Windows, idx: int) -> None:
+        super().__init__(op, idx)
+        self.db = DBHandle(f"{op.name}_r{idx}", op.serialize, op.deserialize,
+                           op.db_dir)
+        # swap the engine's key map for the cache-backed store
+        self.engine.key_map = LRUStore(self.db, op.cache_capacity)
+
+    def flush_on_termination(self) -> None:
+        super().flush_on_termination()
+        self.engine.key_map.flush()
+        self.db.close()

@@ -1,0 +1,91 @@
+"""Message/buffer recycling.
+
+Parity: ``wf/recycling.hpp`` / ``wf/recycling_gpu.hpp`` — every reference
+emitter owns an MPMC pool; consumers return messages to the producer's pool
+instead of freeing, avoiding allocator pressure on the hot path.
+
+In the Python plane, message lifetime is garbage-collected and the hot
+allocations that matter are the COLUMNAR STAGING BUFFERS of the device
+boundary (one numpy array per field per staged batch). ``ArrayPool`` keeps
+free lists keyed by (dtype, capacity); the staging path acquires buffers
+from it and ``BatchTPU`` returns them once the device copy is complete
+(``jax.device_put(np_array)`` copies synchronously into the transfer
+buffer on CPU/TPU backends before returning control, so reuse after
+dispatch is safe; set WF_NO_RECYCLING=1 to disable, mirroring the
+reference's macro)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+RECYCLING_ENABLED = os.environ.get("WF_NO_RECYCLING", "0") != "1"
+
+
+class ArrayPool:
+    """Thread-safe free lists of numpy buffers keyed by (dtype, capacity)."""
+
+    def __init__(self, max_per_bucket: int = 32) -> None:
+        self._free: Dict[Tuple[str, int], List[np.ndarray]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self.max_per_bucket = max_per_bucket
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, dtype, capacity: int) -> np.ndarray:
+        key = (str(np.dtype(dtype)), capacity)
+        if RECYCLING_ENABLED:
+            with self._lock:
+                bucket = self._free.get(key)
+                if bucket:
+                    self.hits += 1
+                    arr = bucket.pop()
+                    arr.fill(0)
+                    return arr
+        self.misses += 1
+        return np.zeros(capacity, dtype=dtype)
+
+    def release(self, arr: np.ndarray) -> None:
+        if not RECYCLING_ENABLED:
+            return
+        key = (str(arr.dtype), arr.shape[0])
+        with self._lock:
+            bucket = self._free[key]
+            if len(bucket) < self.max_per_bucket:
+                bucket.append(arr)
+
+
+#: process-wide staging pool (one per process like the reference's
+#: per-emitter queues would be overkill under the GIL)
+STAGING_POOL = ArrayPool()
+
+
+class ObjectPool:
+    """Generic free list for message objects (Batch and friends)."""
+
+    def __init__(self, factory, reset, max_size: int = 256) -> None:
+        self._factory = factory
+        self._reset = reset
+        self._free: list = []
+        self._lock = threading.Lock()
+        self.max_size = max_size
+
+    def acquire(self):
+        if RECYCLING_ENABLED:
+            with self._lock:
+                if self._free:
+                    obj = self._free.pop()
+                    self._reset(obj)
+                    return obj
+        return self._factory()
+
+    def release(self, obj) -> None:
+        if not RECYCLING_ENABLED:
+            return
+        with self._lock:
+            if len(self._free) < self.max_size:
+                self._free.append(obj)

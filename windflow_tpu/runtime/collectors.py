@@ -199,6 +199,64 @@ class IDSequencerCollector(BasicCollector):
         self._pending.clear()
 
 
+class DPJoinCollector(BasicCollector):
+    """For DP-mode Interval_Join in DEFAULT mode (reference
+    ``wf/join_collector.hpp``): every broadcast replica must observe the
+    SAME tuple sequence so their round-robin storage assignment agrees.
+    Messages buffer until the min watermark across channels STRICTLY
+    passes their timestamp, then release in total (ts, channel, id) order —
+    a content-determined order identical on every replica regardless of
+    arrival interleaving (releasing ts == bound on arrival would expose
+    cross-channel arrival order for ties). Punctuations are forwarded after
+    the releases they trigger."""
+
+    def __init__(self, n_channels: int, next_node: Any,
+                 separator_id: Optional[int] = None) -> None:
+        super().__init__(n_channels, next_node, separator_id)
+        self._ch_wm = [0] * n_channels
+        self._heap: list = []  # (ts, ch, id, msg)
+
+    def _min_wm(self) -> int:
+        if not self.live:
+            return MAX_WM
+        return min(self._ch_wm[c] for c in self.live)
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        wm = msg.min_watermark()
+        if wm > self._ch_wm[ch]:
+            self._ch_wm[ch] = wm
+        self._tag(ch, msg)
+        if not msg.is_punct:
+            ts = msg.rows[0][1] if isinstance(msg, Batch) else msg.ts
+            heapq.heappush(self._heap, (ts, ch, msg.id, msg))
+        bound = self._min_wm()
+        self._release(bound)
+        if msg.is_punct:
+            msg.wm = bound if bound < MAX_WM else wm
+            self.next_node.handle_msg(0, msg)
+
+    def _release(self, bound: int) -> None:
+        # strict: a message with ts == bound could still be followed by a
+        # same-ts message on another channel
+        while self._heap and self._heap[0][0] < bound:
+            _, _, _, m = heapq.heappop(self._heap)
+            if bound < MAX_WM:
+                m.wm = bound
+            # post-EOS drain (bound == MAX_WM): keep each message's own
+            # watermark — inflating it would purge the join archives while
+            # pending pairs still need them
+            self.next_node.handle_msg(0, m)
+
+    def on_channel_eos(self, ch: int) -> None:
+        super().on_channel_eos(ch)
+        self._release(self._min_wm())
+
+    def terminate(self) -> None:
+        while self._heap:
+            _, _, _, m = heapq.heappop(self._heap)
+            self.next_node.handle_msg(0, m)
+
+
 class KSlackCollector(BasicCollector):
     """Adaptive K-slack (``wf/kslack_collector.hpp:99-118``): K tracks the
     maximum observed disorder ``max_ts - ts``; buffered tuples are released in

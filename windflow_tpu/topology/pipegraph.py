@@ -22,9 +22,9 @@ from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
                      RoutingMode, TimePolicy, WindFlowError)
 from ..operators.base import BasicOperator
 from ..runtime.channel import Channel, InlinePort, QueuePort
-from ..runtime.collectors import (AtomicCounter, IDSequencerCollector,
-                                  KSlackCollector, OrderingCollector,
-                                  WatermarkCollector)
+from ..runtime.collectors import (AtomicCounter, DPJoinCollector,
+                                  IDSequencerCollector, KSlackCollector,
+                                  OrderingCollector, WatermarkCollector)
 from ..runtime.emitters import (BasicEmitter, BroadcastEmitter, ForwardEmitter,
                                 KeyByEmitter, NullEmitter, SplittingEmitter)
 from ..runtime.worker import Worker
@@ -49,6 +49,7 @@ class PipeGraph:
         self._built = False
         self._started = False
         self._ended = False
+        self._monitor = None
 
     # ------------------------------------------------------------------
     def _register_op(self, op: BasicOperator) -> None:
@@ -223,6 +224,13 @@ class PipeGraph:
             separator = sum(s.parallelism for s in a_stages)
         mode = self.execution_mode
         if mode is ExecutionMode.DEFAULT:
+            from ..basic import JoinMode
+            if (separator is not None
+                    and getattr(stage.first_op, "join_mode", None)
+                    is JoinMode.DP):
+                # DP join replicas need an identical total order
+                # (reference Join_Collector, wf/multipipe.hpp:216-220)
+                return DPJoinCollector(n_in, first_replica, separator)
             if n_in > 1 or separator is not None:
                 return WatermarkCollector(n_in, first_replica, separator)
             return None
@@ -264,6 +272,12 @@ class PipeGraph:
         self._build()
         self._started = True
         self._t0 = time.monotonic()
+        if os.environ.get("WF_TRACING_ENABLED"):
+            # reference: one MonitoringThread per PipeGraph when tracing
+            # (wf/pipegraph.hpp:671-675)
+            from ..monitoring.monitor import MonitoringThread
+            self._monitor = MonitoringThread(self)
+            self._monitor.start()
         for w in self._workers:
             w.start()
 
@@ -276,6 +290,9 @@ class PipeGraph:
             w.join()
         self._ended = True
         self.elapsed_sec = time.monotonic() - self._t0
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor.join(timeout=3)
         errors = [w.error for w in self._workers if w.error is not None]
         if errors:
             raise errors[0]

@@ -94,6 +94,12 @@ class BatchTPU(StreamMsg):
 
         cap = capacity or bucket_capacity(len(rows))
         cols, ts = schema.to_columns(rows, cap)
+        # NOTE: the staging buffers are NOT recycled here — device_put's
+        # host-side read can complete asynchronously once the dispatch
+        # queue deepens, so reuse corrupts in-flight batches (empirically
+        # observed; this is the async-transfer hazard the reference tracks
+        # with its in-transit counters, batch_gpu_t.hpp:66). recycling.py's
+        # pool can be wired once completion callbacks are plumbed.
         dev_fields = {name: jax.device_put(col) for name, col in cols.items()}
         # per-batch slot ids are computed by the consuming keyed operator
         # (TPUReplicaBase.batch_slots); host_keys is the canonical metadata

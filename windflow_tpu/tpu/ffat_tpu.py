@@ -331,6 +331,25 @@ class FfatTPUReplica(TPUReplicaBase):
             leaves[order0] = (self.count[ss[first_of[grp]]]
                               + np.arange(n) - first_of[grp])
             np.add.at(self.count, slots, 1)
+        # align brand-new keys to the first window containing their first
+        # leaf: without this, an epoch-scale first timestamp would demand a
+        # ring spanning all of absolute time (OOM via _grow_ring)
+        if op.win_type is WinType.TB:
+            fresh = self.max_leaf[slots] < 0
+            if fresh.any():
+                fslots = slots[fresh]
+                fleaves = leaves[fresh]
+                first_leaf = np.full(self.K_cap, np.iinfo(np.int64).max,
+                                     dtype=np.int64)
+                np.minimum.at(first_leaf, fslots, fleaves)
+                sel = np.unique(fslots)
+                new_mask = self.max_leaf[sel] < 0  # still untouched slots
+                sel = sel[new_mask]
+                w0 = np.maximum(
+                    0, (first_leaf[sel] - self.win_units)
+                    // self.slide_units + 1)
+                self.next_fire[sel] = w0 * self.slide_units
+                self.fired[sel] = w0
         live = leaves >= self.next_fire[slots]
         n_late = int(n - live.sum())
         if n_late:
