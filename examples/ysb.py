@@ -1,0 +1,132 @@
+"""Yahoo Streaming Benchmark (ad-campaign windowed counting) on
+windflow_tpu — the last BASELINE.json config.
+
+Classic YSB shape: ad events from Kafka -> filter(view) -> project ->
+join ad->campaign (static table) -> per-campaign tumbling-window counts.
+The windowed count runs on the device plane (Ffat_Windows_TPU with a
+count+latest-ts combine); switch USE_TPU off for the CPU Ffat_Windows.
+
+Run: JAX_PLATFORMS=cpu python examples/ysb.py [n_events]
+(or on a TPU host with the device backend available, leave JAX_PLATFORMS
+unset; YSB_CPU=1 selects the CPU window operator.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dataclasses import dataclass
+
+from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
+                          PipeGraph, Sink_Builder, TimePolicy)
+from windflow_tpu.kafka import Kafka_Source_Builder, MemoryBroker
+
+USE_TPU = os.environ.get("YSB_CPU") != "1"
+N_CAMPAIGNS = 100
+ADS_PER_CAMPAIGN = 10
+WIN_US = 10_000_000  # 10s tumbling windows
+
+
+@dataclass
+class AdEvent:
+    ad_id: int
+    event_type: int  # 0=view 1=click 2=purchase
+    ts: int
+
+
+@dataclass
+class CampaignEvent:
+    campaign: int
+    one: int
+    ts: int
+
+
+def fill_broker(n_events: int) -> None:
+    b = MemoryBroker.get("ysb", 8)
+    for i in range(n_events):
+        b.produce("ad_events", {
+            "ad_id": i % (N_CAMPAIGNS * ADS_PER_CAMPAIGN),
+            "event_type": i % 3,
+            "ts": i * 100,
+        }, key=i % 8)
+
+
+def main(n_events: int = 60_000) -> None:
+    fill_broker(n_events)
+    results = {}
+
+    graph = PipeGraph("ysb", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        p = msg.payload
+        shipper.push_with_timestamp(
+            AdEvent(p["ad_id"], p["event_type"], p["ts"]), p["ts"])
+        shipper.set_next_watermark(p["ts"])
+        return True
+
+    src = (Kafka_Source_Builder(deser).with_brokers("memory://ysb")
+           .with_topics("ad_events").with_idleness(100)
+           .with_parallelism(2)
+           .with_output_batch_size(1024 if USE_TPU else 0).build())
+    views = Filter_Builder(lambda e: e.event_type == 0).with_parallelism(2) \
+        .with_output_batch_size(1024 if USE_TPU else 0).build()
+    # ad -> campaign join against the static campaign table
+    project = (Map_Builder(lambda e: CampaignEvent(
+                   e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts))
+               .with_parallelism(2)
+               .with_output_batch_size(1024 if USE_TPU else 0).build())
+
+    if USE_TPU:
+        from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+        win = (Ffat_Windows_TPU_Builder(
+                   lambda f: {"count": f["one"], "last_ts": f["ts"]},
+                   lambda a, b: {"count": a["count"] + b["count"],
+                                 "last_ts": b["last_ts"]})
+               .with_key_by("campaign")
+               .with_tb_windows(WIN_US, WIN_US)
+               .with_num_win_per_batch(32)
+               .with_key_capacity(N_CAMPAIGNS).build())
+
+        def sink(r):
+            if r is not None and r["valid"]:
+                results[(r["campaign"], r["wid"])] = r["count"]
+    else:
+        from windflow_tpu import Ffat_Windows_Builder
+        win = (Ffat_Windows_Builder(lambda e: e.one, lambda a, b: a + b)
+               .with_key_by(lambda e: e.campaign)
+               .with_tb_windows(WIN_US, WIN_US).build())
+
+        def sink(r):
+            if r is not None and r.value is not None:
+                results[(r.key, r.wid)] = r.value
+
+    graph.add_source(src).add(views).add(project).add(win).add_sink(
+        Sink_Builder(sink).build())
+
+    t0 = time.perf_counter()
+    graph.run()
+    dt = time.perf_counter() - t0
+
+    # model check
+    expected = {}
+    for i in range(n_events):
+        if i % 3 == 0:
+            c = (i % (N_CAMPAIGNS * ADS_PER_CAMPAIGN)) // ADS_PER_CAMPAIGN
+            w = (i * 100) // WIN_US
+            expected[(c, w)] = expected.get((c, w), 0) + 1
+    ok = results == expected
+    print(f"YSB [{'TPU' if USE_TPU else 'CPU'}]: {n_events} events in "
+          f"{dt:.2f}s ({n_events/dt:,.0f} ev/s), "
+          f"{len(results)} campaign-windows, model match: {ok}")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 60_000)

@@ -136,3 +136,41 @@ def test_join_asymmetric_bounds():
         .add(join).add_sink(Sink_Builder(coll.sink).build())
     graph.run()
     assert set(coll.pairs) == {(1, 11), (1, 12)}
+
+
+def test_interval_join_dp_batched_inputs():
+    """Batched producers feeding a DP join: the collector must flatten
+    batches so the per-row ts order (the purge frontier) holds."""
+    rng = random.Random(77)
+    expected = model_pairs()
+    coll = PairCollector()
+    graph = PipeGraph("join_dp_batched", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    a = (Source_Builder(src_a).with_parallelism(2)
+         .with_output_batch_size(50).build())
+    b = (Source_Builder(src_b).with_parallelism(2)
+         .with_output_batch_size(37).build())
+    join = (Interval_Join_Builder(lambda x, y: (x.key, x.value, y.value))
+            .with_key_by(lambda t: t.key).with_boundaries(LOWER, UPPER)
+            .with_dp_mode().with_parallelism(3).build())
+    graph.add_source(a).merge(graph.add_source(b)).add(join).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    got = set(coll.pairs)
+    assert len(coll.pairs) == len(got), "duplicate join results"
+    assert got == expected
+
+
+def test_interval_join_dp_rejected_in_probabilistic():
+    from windflow_tpu import WindFlowError
+    graph = PipeGraph("join_dp_prob", ExecutionMode.PROBABILISTIC,
+                      TimePolicy.EVENT_TIME)
+    a = Source_Builder(src_a).build()
+    b = Source_Builder(src_b).build()
+    join = (Interval_Join_Builder(lambda x, y: None)
+            .with_key_by(lambda t: t.key).with_boundaries(0, 0)
+            .with_dp_mode().build())
+    graph.add_source(a).merge(graph.add_source(b)).add(join).add_sink(
+        Sink_Builder(lambda t: None).build())
+    with pytest.raises(WindFlowError, match="PROBABILISTIC"):
+        graph.run()

@@ -1,0 +1,51 @@
+"""Pool substrate tests (reference wf/recycling.hpp capability; see
+windflow_tpu/recycling.py for why the device staging path does not use the
+pools yet)."""
+
+import threading
+
+import numpy as np
+
+from windflow_tpu.recycling import ArrayPool, ObjectPool
+
+
+def test_array_pool_reuse_and_zeroing():
+    pool = ArrayPool(max_per_bucket=4)
+    a = pool.acquire(np.int32, 64)
+    a[:] = 7
+    pool.release(a)
+    b = pool.acquire(np.int32, 64)
+    assert b is a  # reused
+    assert (b == 0).all()  # zeroed on reacquire
+    c = pool.acquire(np.float32, 64)
+    assert c is not a and c.dtype == np.float32
+
+
+def test_array_pool_bucket_cap():
+    pool = ArrayPool(max_per_bucket=2)
+    arrs = [pool.acquire(np.int64, 8) for _ in range(5)]
+    for a in arrs:
+        pool.release(a)
+    assert len(pool._free[(str(np.dtype(np.int64)), 8)]) == 2
+
+
+def test_object_pool_threaded():
+    made = []
+
+    def factory():
+        o = {"v": 0}
+        made.append(o)
+        return o
+
+    pool = ObjectPool(factory, reset=lambda o: o.update(v=0), max_size=16)
+
+    def worker():
+        for _ in range(500):
+            o = pool.acquire()
+            o["v"] += 1
+            pool.release(o)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(made) <= 32  # heavy reuse, not 2000 allocations

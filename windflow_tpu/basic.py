@@ -102,6 +102,13 @@ def current_time_usecs() -> int:
 _MISSING = object()
 
 
+def env_flag(name: str) -> bool:
+    """Consistent boolean env semantics: '1'/'true'/'yes'/'on' enable."""
+    import os
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes",
+                                                        "on")
+
+
 def identity(x):
     return x
 

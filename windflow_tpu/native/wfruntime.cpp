@@ -1,0 +1,194 @@
+// wfruntime: native runtime primitives for the CPU plane.
+//
+// The reference rides FastFlow's lock-free SPSC queues between pinned
+// threads (SURVEY.md L0). This library provides the same substrate for the
+// Python plane without taking the interpreter on the hot path:
+//
+//  - wf_queue: bounded MPSC ring of (channel_id, PyObject*) pairs with a
+//    mutex/condvar protocol tuned for the single-consumer case (one worker
+//    thread per replica chain, like ff_minode). Blocking waits release the
+//    GIL (callers use ctypes CDLL for push/pop wrappers that never touch
+//    Python state while blocked); object reference counts are managed by
+//    the Python wrapper, which owns one strong reference per enqueued
+//    message (transferred to the consumer on pop).
+//  - wf_encode_*: row->column staging encoders driven through the CPython
+//    API (built as part of the same shared object, called under the GIL via
+//    PyDLL): one C pass extracts a named attribute (or dict item) from a
+//    sequence of tuples straight into numpy-owned buffers, replacing the
+//    per-row per-field Python interpreter loop at the device boundary.
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py); no external
+// dependencies beyond Python.h.
+
+#include <Python.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Bounded MPSC queue
+// ---------------------------------------------------------------------------
+struct WfItem {
+    int64_t tag;       // channel id (or EOS marker from the wrapper)
+    uintptr_t handle;  // PyObject* owned by the producer-side incref
+};
+
+struct WfQueue {
+    WfItem* buf;
+    size_t capacity;
+    size_t head;  // consumer index
+    size_t tail;  // producer index
+    size_t count;
+    std::mutex m;
+    std::condition_variable not_full;
+    std::condition_variable not_empty;
+};
+
+void* wf_queue_create(size_t capacity) {
+    WfQueue* q = new (std::nothrow) WfQueue();
+    if (!q) return nullptr;
+    q->buf = new (std::nothrow) WfItem[capacity];
+    if (!q->buf) {
+        delete q;
+        return nullptr;
+    }
+    q->capacity = capacity;
+    q->head = q->tail = q->count = 0;
+    return q;
+}
+
+void wf_queue_destroy(void* h) {
+    WfQueue* q = static_cast<WfQueue*>(h);
+    if (!q) return;
+    delete[] q->buf;
+    delete q;
+}
+
+// Blocking push; returns 1 on success. Called WITHOUT the GIL (ctypes CDLL
+// releases it), so this may block freely.
+int wf_queue_push(void* h, int64_t tag, uintptr_t handle) {
+    WfQueue* q = static_cast<WfQueue*>(h);
+    std::unique_lock<std::mutex> lk(q->m);
+    q->not_full.wait(lk, [q] { return q->count < q->capacity; });
+    q->buf[q->tail] = WfItem{tag, handle};
+    q->tail = (q->tail + 1) % q->capacity;
+    q->count++;
+    lk.unlock();
+    q->not_empty.notify_one();
+    return 1;
+}
+
+// Blocking pop; fills tag/handle, returns 1. timeout_ms < 0 => wait forever;
+// returns 0 on timeout.
+int wf_queue_pop(void* h, int64_t* tag, uintptr_t* handle,
+                 long timeout_ms) {
+    WfQueue* q = static_cast<WfQueue*>(h);
+    std::unique_lock<std::mutex> lk(q->m);
+    if (timeout_ms < 0) {
+        q->not_empty.wait(lk, [q] { return q->count > 0; });
+    } else {
+        if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   [q] { return q->count > 0; }))
+            return 0;
+    }
+    WfItem it = q->buf[q->head];
+    q->head = (q->head + 1) % q->capacity;
+    q->count--;
+    lk.unlock();
+    q->not_full.notify_one();
+    *tag = it.tag;
+    *handle = it.handle;
+    return 1;
+}
+
+size_t wf_queue_len(void* h) {
+    WfQueue* q = static_cast<WfQueue*>(h);
+    std::lock_guard<std::mutex> lk(q->m);
+    return q->count;
+}
+
+// ---------------------------------------------------------------------------
+// Columnar staging encoders (called WITH the GIL via ctypes.PyDLL)
+// ---------------------------------------------------------------------------
+// rows: PyObject* to a list of payload objects; attr: field name;
+// out: pointer to an int64/float64 buffer of length >= n.
+// Returns 0 on success, -1 on error (Python exception set).
+
+static inline PyObject* wf_get_field(PyObject* row, PyObject* attr) {
+    if (PyDict_Check(row)) {
+        PyObject* v = PyDict_GetItemWithError(row, attr);  // borrowed
+        if (v) Py_INCREF(v);
+        else if (!PyErr_Occurred())
+            PyErr_SetObject(PyExc_KeyError, attr);
+        return v;
+    }
+    return PyObject_GetAttr(row, attr);
+}
+
+int wf_encode_i64(PyObject* rows, PyObject* attr, int64_t* out) {
+    Py_ssize_t n = PyList_Size(rows);
+    if (n < 0) return -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PyList_GET_ITEM(rows, i);  // borrowed
+        PyObject* v = wf_get_field(row, attr);
+        if (!v) return -1;
+        long long x = PyLong_AsLongLong(v);
+        Py_DECREF(v);
+        if (x == -1 && PyErr_Occurred()) return -1;
+        out[i] = (int64_t)x;
+    }
+    return 0;
+}
+
+int wf_encode_f64(PyObject* rows, PyObject* attr, double* out) {
+    Py_ssize_t n = PyList_Size(rows);
+    if (n < 0) return -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PyList_GET_ITEM(rows, i);  // borrowed
+        PyObject* v = wf_get_field(row, attr);
+        if (!v) return -1;
+        double x = PyFloat_AsDouble(v);
+        Py_DECREF(v);
+        if (x == -1.0 && PyErr_Occurred()) return -1;
+        out[i] = x;
+    }
+    return 0;
+}
+
+int wf_encode_i32(PyObject* rows, PyObject* attr, int32_t* out) {
+    Py_ssize_t n = PyList_Size(rows);
+    if (n < 0) return -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PyList_GET_ITEM(rows, i);  // borrowed
+        PyObject* v = wf_get_field(row, attr);
+        if (!v) return -1;
+        long long x = PyLong_AsLongLong(v);
+        Py_DECREF(v);
+        if (x == -1 && PyErr_Occurred()) return -1;
+        out[i] = (int32_t)x;
+    }
+    return 0;
+}
+
+int wf_encode_f32(PyObject* rows, PyObject* attr, float* out) {
+    Py_ssize_t n = PyList_Size(rows);
+    if (n < 0) return -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PyList_GET_ITEM(rows, i);  // borrowed
+        PyObject* v = wf_get_field(row, attr);
+        if (!v) return -1;
+        double x = PyFloat_AsDouble(v);
+        Py_DECREF(v);
+        if (x == -1.0 && PyErr_Occurred()) return -1;
+        out[i] = (float)x;
+    }
+    return 0;
+}
+
+}  // extern "C"

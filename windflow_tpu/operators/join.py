@@ -58,6 +58,18 @@ class Interval_Join(BasicOperator):
     def is_chainable(self) -> bool:
         return False
 
+    def configure(self, execution_mode, time_policy) -> None:
+        from ..basic import ExecutionMode
+        if (self.join_mode is JoinMode.DP
+                and execution_mode is ExecutionMode.PROBABILISTIC):
+            # K-slack reordering is arrival-dependent per replica, so
+            # broadcast DP replicas would disagree on storage assignment
+            raise WindFlowError(
+                f"{self.name}: DP-mode Interval_Join is not supported in "
+                "PROBABILISTIC mode (per-replica K-slack ordering diverges);"
+                " use KP mode")
+        super().configure(execution_mode, time_policy)
+
     def build_replicas(self) -> None:
         self.replicas = [IntervalJoinReplica(self, i)
                          for i in range(self.parallelism)]

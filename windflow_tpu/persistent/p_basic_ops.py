@@ -84,8 +84,10 @@ class _PersistentReplica(BasicReplica):
 
 # ---------------------------------------------------------------------------
 class P_Map(_PersistentOperator):
-    """func(tuple, state) -> (mapped, new_state) (or mutate state and
-    return mapped)."""
+    """func(tuple, state) -> (mapped, new_state). The pair is mandatory —
+    a mutate-style functor returns (mapped, state) with the same (mutated)
+    state object; inferring intent from the return shape would corrupt
+    state whenever the mapped value itself is a 2-tuple."""
 
 
 class PMapReplica(_PersistentReplica):
@@ -93,10 +95,11 @@ class PMapReplica(_PersistentReplica):
         key = self.op.key_extractor(payload)
         st = self._get_state(key)
         out = self._call(payload, st)
-        if isinstance(out, tuple) and len(out) == 2:
-            result, st = out
-        else:
-            result = out
+        if not (isinstance(out, tuple) and len(out) == 2):
+            raise WindFlowError(
+                f"{self.op.name}: P_Map functor must return "
+                "(result, new_state)")
+        result, st = out
         self.state[key] = st
         if result is not None:
             self.emitter.emit(result, ts, wm)
@@ -106,8 +109,8 @@ P_Map.replica_cls = PMapReplica
 
 
 class P_Filter(_PersistentOperator):
-    """func(tuple, state) -> (keep, new_state) (or mutate state, return
-    keep)."""
+    """func(tuple, state) -> (keep, new_state); the pair is mandatory
+    (see P_Map)."""
 
 
 class PFilterReplica(_PersistentReplica):
@@ -115,10 +118,11 @@ class PFilterReplica(_PersistentReplica):
         key = self.op.key_extractor(payload)
         st = self._get_state(key)
         out = self._call(payload, st)
-        if isinstance(out, tuple) and len(out) == 2:
-            keep, st = out
-        else:
-            keep = out
+        if not (isinstance(out, tuple) and len(out) == 2):
+            raise WindFlowError(
+                f"{self.op.name}: P_Filter functor must return "
+                "(keep, new_state)")
+        keep, st = out
         self.state[key] = st
         if keep:
             self.emitter.emit(payload, ts, wm)

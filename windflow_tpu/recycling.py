@@ -59,11 +59,6 @@ class ArrayPool:
                 bucket.append(arr)
 
 
-#: process-wide staging pool (one per process like the reference's
-#: per-emitter queues would be overkill under the GIL)
-STAGING_POOL = ArrayPool()
-
-
 class ObjectPool:
     """Generic free list for message objects (Batch and friends)."""
 

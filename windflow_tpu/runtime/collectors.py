@@ -227,8 +227,15 @@ class DPJoinCollector(BasicCollector):
             self._ch_wm[ch] = wm
         self._tag(ch, msg)
         if not msg.is_punct:
-            ts = msg.rows[0][1] if isinstance(msg, Batch) else msg.ts
-            heapq.heappush(self._heap, (ts, ch, msg.id, msg))
+            if isinstance(msg, Batch):
+                # flatten: ordering whole batches by their first row would
+                # break the per-row ts order the DP purge frontier relies on
+                for ri, (payload, ts) in enumerate(msg.rows):
+                    row = Single(payload, (msg.id << 20) | ri, ts, msg.wm)
+                    row.stream_tag = msg.stream_tag
+                    heapq.heappush(self._heap, (ts, ch, row.id, row))
+            else:
+                heapq.heappush(self._heap, (msg.ts, ch, msg.id, msg))
         bound = self._min_wm()
         self._release(bound)
         if msg.is_punct:

@@ -19,7 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..basic import (DEFAULT_BUFFER_CAPACITY, ExecutionMode, OpType,
-                     RoutingMode, TimePolicy, WindFlowError)
+                     RoutingMode, TimePolicy, WindFlowError, env_flag)
 from ..operators.base import BasicOperator
 from ..runtime.channel import Channel, InlinePort, QueuePort
 from ..runtime.collectors import (AtomicCounter, DPJoinCollector,
@@ -79,10 +79,16 @@ class PipeGraph:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)
                 op.build_replicas()
-        # channels (one per consumer replica)
+        # channels (one per consumer replica); the native C++ ring is used
+        # when requested and buildable (WF_NATIVE_CHANNELS=1)
+        channel_cls = Channel
+        if env_flag("WF_NATIVE_CHANNELS"):
+            from ..native import NativeChannel, native_available
+            if native_available():
+                channel_cls = NativeChannel
         for s in self._stages:
             if not s.is_source:
-                s.channels = [Channel(self.channel_capacity)
+                s.channels = [channel_cls(self.channel_capacity)
                               for _ in range(s.parallelism)]
         # intra-stage chain wiring (fused InlinePort edges)
         for s in self._stages:
@@ -272,7 +278,7 @@ class PipeGraph:
         self._build()
         self._started = True
         self._t0 = time.monotonic()
-        if os.environ.get("WF_TRACING_ENABLED"):
+        if env_flag("WF_TRACING_ENABLED"):
             # reference: one MonitoringThread per PipeGraph when tracing
             # (wf/pipegraph.hpp:671-675)
             from ..monitoring.monitor import MonitoringThread
@@ -296,7 +302,7 @@ class PipeGraph:
         errors = [w.error for w in self._workers if w.error is not None]
         if errors:
             raise errors[0]
-        if os.environ.get("WF_TRACING_ENABLED"):
+        if env_flag("WF_TRACING_ENABLED"):
             self.dump_stats(os.environ.get("WF_LOG_DIR", "log"))
 
     def run(self) -> None:

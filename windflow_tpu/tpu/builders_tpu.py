@@ -90,6 +90,13 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         self._win_type = None
         self._lateness = 0
         self._nwpb = 16
+        self._key_capacity = 16
+
+    def with_key_capacity(self, n: int):
+        """Expected distinct-key count per replica (pre-sizes the device
+        forest; avoids growth recompiles on streams with many keys)."""
+        self._key_capacity = n
+        return self
 
     def with_cb_windows(self, win_len: int, slide_len: int):
         from ..basic import WinType
@@ -123,4 +130,4 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
             self._func, self._combine, self._key_extractor, self._win_len,
             self._slide_len, self._win_type, self._lateness, self._nwpb,
             self._name, self._parallelism, self._output_batch_size,
-            self._schema))
+            self._schema, self._key_capacity))

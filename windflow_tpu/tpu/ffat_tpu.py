@@ -59,7 +59,8 @@ class Ffat_Windows_TPU(TPUOperatorBase):
                  num_win_per_batch: int = 16,
                  name: str = "ffat_windows_tpu", parallelism: int = 1,
                  output_batch_size: int = 0,
-                 schema: Optional[TupleSchema] = None) -> None:
+                 schema: Optional[TupleSchema] = None,
+                 key_capacity: int = 16) -> None:
         if key_extractor is None:
             raise WindFlowError(f"{name}: requires a key extractor")
         if win_len <= 0 or slide_len <= 0:
@@ -73,6 +74,7 @@ class Ffat_Windows_TPU(TPUOperatorBase):
         self.win_type = win_type
         self.lateness = lateness
         self.num_win_per_batch = max(1, num_win_per_batch)
+        self.key_capacity = max(1, key_capacity)
         self.pane_len = math.gcd(win_len, slide_len)
 
     def build_replicas(self) -> None:
@@ -92,7 +94,9 @@ class FfatTPUReplica(TPUReplicaBase):
         # ring length: window + slack for panes ahead of the watermark
         self.F = 1 << max(3, math.ceil(math.log2(
             self.win_units + max(2 * self.slide_units, 16))))
-        self.K_cap = 16
+        # pre-sizing the key table avoids growth recompiles
+        # (wf/builders_gpu.hpp has no analog; growth still works past it)
+        self.K_cap = 1 << max(2, math.ceil(math.log2(op.key_capacity)))
         self.W_cap = op.num_win_per_batch
         self.slot_of_key: Dict[Any, int] = {}
         self._out_keys_by_slot: List[Any] = []
@@ -316,8 +320,16 @@ class FfatTPUReplica(TPUReplicaBase):
         if op.key_field is not None and op.key_field in batch.fields:
             self._key_dtype = np.dtype(batch.fields[op.key_field].dtype)
         keys = self.batch_keys(batch)
-        slots = np.fromiter((self._slot(k) for k in keys), dtype=np.int64,
-                            count=n)
+        keys_arr = np.asarray(keys)
+        if keys_arr.dtype.kind in "iu":
+            # vectorized slot mapping: one _slot call per DISTINCT key
+            uniq, inverse = np.unique(keys_arr, return_inverse=True)
+            slot_map = np.fromiter((self._slot(int(k)) for k in uniq),
+                                   dtype=np.int64, count=len(uniq))
+            slots = slot_map[inverse]
+        else:
+            slots = np.fromiter((self._slot(k) for k in keys),
+                                dtype=np.int64, count=n)
         if op.win_type is WinType.TB:
             leaves = batch.ts_host[:n] // op.pane_len
         else:
@@ -381,7 +393,10 @@ class FfatTPUReplica(TPUReplicaBase):
         seg_leaves_h = leaves[order][seg_pos_h]
 
         cap = batch.capacity
-        s_cap = bucket_capacity(max(1, n_segs))
+        # s_cap pinned to the batch capacity: a per-batch bucket from the
+        # observed segment count churned XLA recompiles (segments <= n <= cap
+        # always holds)
+        s_cap = cap
         order_p = np.zeros(cap, dtype=np.int32)
         order_p[:n] = order
         same_p = np.zeros(cap, dtype=bool)

@@ -39,6 +39,7 @@ class TupleSchema:
             name: np.dtype(dt) for name, dt in fields.items()}
         self.constructor = constructor  # None => rows come back as dicts
         self._names = list(self.fields)
+        self._native_ok: Optional[bool] = None  # encode path memo
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -73,6 +74,9 @@ class TupleSchema:
         cols = {name: np.zeros(capacity, dtype=dt)
                 for name, dt in self.fields.items()}
         ts = np.zeros(capacity, dtype=np.int64)
+        n = len(rows)
+        if n and self._try_native(rows, cols, ts, n):
+            return cols, ts
         # access mode follows the PAYLOADS (an explicit dict schema may be
         # used with dataclass tuples and vice versa)
         by_item = bool(rows) and isinstance(rows[0][0], dict)
@@ -85,6 +89,31 @@ class TupleSchema:
                 for name in self._names:
                     cols[name][i] = getattr(p, name)
         return cols, ts
+
+    def _try_native(self, rows, cols, ts, n) -> bool:
+        """One C pass per column instead of a Python loop per row*field
+        (windflow_tpu.native staging encoders). The first failure disables
+        the path for this schema — retrying a doomed C pass per batch would
+        double staging cost forever."""
+        if self._native_ok is False:
+            return False
+        from ..native import ENCODABLE_DTYPES, encode_column, native_available
+        if self._native_ok is None:
+            if not native_available() or any(
+                    str(dt) not in ENCODABLE_DTYPES
+                    for dt in self.fields.values()):
+                self._native_ok = False
+                return False
+        payloads = [r[0] for r in rows]
+        try:
+            for name in self._names:
+                encode_column(payloads, name, cols[name][:n])
+            ts[:n] = [r[1] for r in rows]
+            self._native_ok = True
+            return True
+        except Exception:
+            self._native_ok = False
+            return False
 
     def from_columns(self, cols: Dict[str, np.ndarray], ts: np.ndarray,
                      n: int) -> List[Tuple[Any, int]]:
