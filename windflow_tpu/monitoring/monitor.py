@@ -127,9 +127,74 @@ class MonitoringServer:
                     "reports": dict(self.reports),
                     "n_reports": self.n_reports}
 
+    # -- web view (the reference ships a Spring+React dashboard; this is
+    # the minimal in-tree equivalent: JSON API + a static HTML view) ------
+    def serve_http(self, port: int = 0) -> int:
+        """Start an HTTP view; returns the bound port.
+        GET /        -> HTML overview
+        GET /json    -> full snapshot
+        GET /graph/<name> -> one graph's latest stats"""
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body, ctype="application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                snap = server.snapshot()
+                if self.path == "/json":
+                    self._send(200, json.dumps(snap))
+                elif self.path.startswith("/graph/"):
+                    name = self.path[len("/graph/"):]
+                    st = snap["reports"].get(name)
+                    if st is None:
+                        self._send(404, json.dumps({"error": "unknown graph"}))
+                    else:
+                        self._send(200, json.dumps(st))
+                else:
+                    rows = []
+                    for g, st in snap["reports"].items():
+                        ops = "".join(
+                            f"<tr><td>{o['name']}</td><td>{o['kind']}</td>"
+                            f"<td>{o['parallelism']}</td>"
+                            f"<td>{sum(r['Inputs_received'] for r in o['replicas'])}</td>"
+                            f"<td>{sum(r['Outputs_sent'] for r in o['replicas'])}</td></tr>"
+                            for o in st.get("Operators", []))
+                        rows.append(
+                            f"<h2>{g} <small>[{st.get('Mode')}] threads="
+                            f"{st.get('Threads')} dropped="
+                            f"{st.get('Dropped_tuples')}</small></h2>"
+                            f"<table border=1 cellpadding=4><tr><th>op</th>"
+                            f"<th>kind</th><th>par</th><th>in</th><th>out</th>"
+                            f"</tr>{ops}</table>"
+                            f"<pre>{snap['diagrams'].get(g, '')}</pre>")
+                    self._send(200,
+                               "<html><body><h1>windflow_tpu dashboard</h1>"
+                               + "".join(rows) + "</body></html>",
+                               "text/html")
+
+        httpd = http.server.ThreadingHTTPServer((self.host, port), Handler)
+        self._httpd = httpd
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd.server_address[1]
+
     def close(self) -> None:
         self._stop.set()
         try:
             self._srv.close()
         except OSError:
             pass
+        httpd = getattr(self, "_httpd", None)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()  # release the bound listening socket

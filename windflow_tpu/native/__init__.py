@@ -49,9 +49,11 @@ def _load() -> bool:
         if not os.path.exists(_SO) or (os.path.getmtime(_SO)
                                        < os.path.getmtime(_SRC)):
             err = _build()
-            if err:
+            if err and not os.path.exists(_SO):
                 _build_error = err
                 return False
+            # a failed rebuild with a prebuilt .so on disk (e.g. fresh
+            # checkout mtimes, no toolchain) falls back to loading it
         try:
             lib = ctypes.CDLL(_SO)
             pylib = ctypes.PyDLL(_SO)
@@ -140,6 +142,8 @@ class NativeChannel:
         return int(_lib.wf_queue_len(self._h))
 
     def __del__(self):
+        if not getattr(self, "_h", None):
+            return  # construction failed before the ring existed
         try:
             while True:
                 item = self.get_nowait()
@@ -147,9 +151,8 @@ class NativeChannel:
                     break
         except Exception:
             pass
-        if getattr(self, "_h", None):
-            _lib.wf_queue_destroy(self._h)
-            self._h = None
+        _lib.wf_queue_destroy(self._h)
+        self._h = None
 
 
 def encode_column(rows: list, field: str, out) -> None:

@@ -1,4 +1,5 @@
-from .mesh import (make_key_mesh, sharded_keyby_window_step,
-                   make_sharded_state)
+from .mesh import (make_key_mesh, ring_pane_window_query,
+                   make_sharded_state, sharded_keyby_window_step)
 
-__all__ = ["make_key_mesh", "sharded_keyby_window_step", "make_sharded_state"]
+__all__ = ["make_key_mesh", "sharded_keyby_window_step",
+           "make_sharded_state", "ring_pane_window_query"]

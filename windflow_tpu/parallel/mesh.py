@@ -148,3 +148,66 @@ def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
         out_specs=(P("key", None), P("key", None), P()),
     )
     return jax.jit(stepped), n_keys_padded, ka * da * local_batch
+
+
+def ring_pane_window_query(mesh, n_panes_global: int, win_panes: int,
+                           slide_panes: int):
+    """Sliding-window combines over a PANE-SHARDED timeline — the
+    long-context analog: when one chip cannot hold a window's pane state
+    (SURVEY.md §5: pane decomposition / window partitioning is how the
+    reference scales window length), the pane axis itself is sharded over
+    the mesh's 'key' axis; a shard owns the windows STARTING in its slice,
+    which extend up to win-1 panes into the RIGHT neighbor, so each shard
+    receives the head of its right neighbor via a RING exchange
+    (``lax.ppermute`` over ICI), not a full all_gather.
+
+    Builds a jitted fn: (pane_partials[P_global]) -> window_sums[W_global]
+    where window w = sum of panes [w*slide, w*slide+win). Collectives move
+    exactly the overlap, O(win) per link, independent of timeline length.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape["key"]
+    if n_panes_global % n_shards:
+        raise ValueError("n_panes_global must divide the key axis")
+    p_local = n_panes_global // n_shards
+    halo = win_panes - 1
+    if halo > p_local:
+        raise ValueError("window span exceeds one shard + halo; increase "
+                         "panes per shard")
+    n_windows = (n_panes_global - win_panes) // slide_panes + 1
+
+    def local(panes):
+        # panes: (p_local,) this shard's slice of the timeline. A shard
+        # owns the windows STARTING in its slice; those extend up to
+        # win-1 panes into the RIGHT neighbor, so the halo is the right
+        # neighbor's head (ring ppermute: shard i sends its head to i-1).
+        perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+        right_head = lax.ppermute(panes[:halo], "key", perm) \
+            if halo > 0 else jnp.zeros((0,), panes.dtype)
+        shard = lax.axis_index("key")
+        ext = jnp.concatenate([panes, right_head])  # (p_local + halo,)
+        start0_global = shard * p_local
+        first_w = (start0_global + slide_panes - 1) // slide_panes
+        max_w_here = p_local // slide_panes + 1
+        w_ids = first_w + jnp.arange(max_w_here)
+        starts_local = w_ids * slide_panes - start0_global
+        valid = (w_ids < n_windows) & (starts_local < p_local)
+        idx = jnp.clip(starts_local[:, None]
+                       + jnp.arange(win_panes)[None, :],
+                       0, p_local + halo - 1)
+        sums = jnp.where(valid[:, None], ext[idx], 0).sum(axis=1)
+        # each window is produced by exactly one shard; psum assembles the
+        # dense global window vector
+        out = jnp.zeros((n_windows,), panes.dtype)
+        out = out.at[jnp.clip(w_ids, 0, n_windows - 1)].add(
+            jnp.where(valid, sums, 0))
+        return lax.psum(out, "key")
+
+    stepped = shard_map(local, mesh=mesh,
+                        in_specs=(P("key"),), out_specs=P())
+    return jax.jit(stepped), n_windows
