@@ -1,0 +1,121 @@
+"""Split/merge around device stages (reference tests/split_tests_gpu,
+merge_tests_gpu incl. the _kb keyby variants): branching PipeGraphs where
+branches run TPU operators, merges of device pipelines, and keyed shuffles
+on the way in/out."""
+
+import random
+import threading
+
+from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import (Filter_TPU_Builder, Map_TPU_Builder,
+                              Reduce_TPU_Builder)
+
+from common import GlobalSum, TupleT, make_ingress_source, make_sum_sink, \
+    rand_degree
+
+N_KEYS = 6
+STREAM_LEN = 60
+
+
+def test_split_into_tpu_branches():
+    """CPU split whose branches are device pipelines (split_tests_gpu)."""
+    rng = random.Random(11)
+    last = None
+    for _ in range(3):
+        accA, accB = GlobalSum(), GlobalSum()
+        graph = PipeGraph("split_tpu")
+        src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(16).build())
+        mp = graph.add_source(src)
+        mp.split(lambda t: 0 if t.value % 2 == 0 else 1, 2)
+        b0 = mp.select(0)
+        b0.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 10})
+               .with_parallelism(rand_degree(rng)).build())
+        b0.add_sink(Sink_Builder(make_sum_sink(accA)).build())
+        b1 = mp.select(1)
+        b1.add(Filter_TPU_Builder(lambda f: f["value"] % 3 != 0)
+               .with_parallelism(rand_degree(rng)).build())
+        b1.add_sink(Sink_Builder(make_sum_sink(accB)).build())
+        graph.run()
+        cur = (accA.value, accA.count, accB.value, accB.count)
+        if last is None:
+            last = cur
+        else:
+            assert cur == last
+    evens = [v for v in range(1, STREAM_LEN + 1) if v % 2 == 0]
+    odds = [v for v in range(1, STREAM_LEN + 1) if v % 2 == 1]
+    assert last[0] == N_KEYS * 10 * sum(evens)
+    assert last[2] == N_KEYS * sum(v for v in odds if v % 3 != 0)
+
+
+def test_merge_tpu_pipelines_kb():
+    """Two device pipelines merged into one keyed device reduce (the _kb
+    merge variant: the merged edge is a keyed shuffle)."""
+    rng = random.Random(13)
+    acc = {}
+    lock = threading.Lock()
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                acc[t.key] = acc.get(t.key, 0) + t.value
+
+    graph = PipeGraph("merge_tpu_kb")
+    s1 = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+          .with_parallelism(2).with_output_batch_size(16).build())
+    s2 = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+          .with_parallelism(1).with_output_batch_size(8).build())
+    mp1 = graph.add_source(s1)
+    mp1.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 2})
+            .with_key_by("key").with_parallelism(2).build())
+    mp2 = graph.add_source(s2)
+    mp2.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 5})
+            .with_key_by("key").with_parallelism(2).build())
+    merged = mp1.merge(mp2)
+    merged.add(Reduce_TPU_Builder(
+        lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+        .with_key_by("key").with_parallelism(3).build())
+    merged.add_sink(Sink_Builder(sink).build())
+    graph.run()
+    total = sum(range(1, STREAM_LEN + 1))
+    expected = {k: 2 * total + 5 * total for k in range(N_KEYS)}
+    assert acc == expected
+
+
+def test_tpu_exit_then_split_then_merge():
+    """Device stage -> host exit -> split -> per-branch CPU transforms ->
+    merge -> sink: the full diamond with a device head."""
+    acc = GlobalSum()
+    graph = PipeGraph("tpu_diamond")
+    src = (Source_Builder(make_ingress_source(4, 50))
+           .with_output_batch_size(16).build())
+    mp = graph.add_source(src)
+    mp.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] + 1}).build())
+    # exit the device plane before splitting (validated requirement)
+    mp.add(Map_Builder(lambda t: t).build())
+    mp.split(lambda t: t.value % 2, 2)
+    b0 = mp.select(0).add(Map_Builder(lambda t: TupleT(t.key, t.value)).build())
+    b1 = mp.select(1).add(Map_Builder(lambda t: TupleT(t.key, 100 * t.value)).build())
+    b0.merge(b1).add_sink(Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    vals = [v + 1 for v in range(1, 51)]
+    expected = 4 * sum(v if v % 2 == 0 else 100 * v for v in vals)
+    assert acc.value == expected
+    assert acc.count == 4 * 50
+
+
+def test_split_after_tpu_requires_host_exit():
+    import pytest
+    from windflow_tpu import WindFlowError
+    graph = PipeGraph("tpu_split_bad")
+    src = (Source_Builder(make_ingress_source(1, 4))
+           .with_output_batch_size(4).build())
+    mp = graph.add_source(src)
+    mp.add(Map_TPU_Builder(lambda f: f).build())
+    mp.split(lambda t: 0, 2)
+    mp.select(0).add_sink(Sink_Builder(lambda t: None).build())
+    mp.select(1).add_sink(Sink_Builder(lambda t: None).build())
+    with pytest.raises(WindFlowError, match="split"):
+        graph.run()
