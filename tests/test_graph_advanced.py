@@ -1,0 +1,97 @@
+"""Advanced DAG shapes from the reference graph_tests family: multi-way
+splits, merges of three pipes, split-of-split nesting, chained sinks after
+shuffles — randomized degrees with checksum invariance."""
+
+import random
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
+                          PipeGraph, Sink_Builder, Source_Builder)
+
+from common import GlobalSum, TupleT, make_ingress_source, make_sum_sink, \
+    rand_batch, rand_degree
+
+N_KEYS = 5
+STREAM_LEN = 40
+
+
+@pytest.mark.parametrize("mode", [ExecutionMode.DEFAULT,
+                                  ExecutionMode.DETERMINISTIC])
+def test_three_way_split(mode):
+    rng = random.Random(31)
+    last = None
+    for _ in range(3):
+        accs = [GlobalSum() for _ in range(3)]
+        graph = PipeGraph("split3", mode)
+        src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(rand_batch(rng)).build())
+        mp = graph.add_source(src)
+        mp.split(lambda t: t.value % 3, 3)
+        for b in range(3):
+            (mp.select(b)
+             .add(Map_Builder(lambda t, _b=b: TupleT(t.key, t.value * (10 ** _b)))
+                  .with_parallelism(rand_degree(rng)).build())
+             .add_sink(Sink_Builder(make_sum_sink(accs[b])).build()))
+        graph.run()
+        cur = tuple((a.value, a.count) for a in accs)
+        if last is None:
+            last = cur
+        else:
+            assert cur == last
+    for b in range(3):
+        expect = N_KEYS * sum(v * (10 ** b) for v in range(1, STREAM_LEN + 1)
+                              if v % 3 == b)
+        assert last[b][0] == expect
+
+
+def test_merge_three_pipes():
+    acc = GlobalSum()
+    graph = PipeGraph("merge3")
+    pipes = []
+    for mul in (1, 100, 10_000):
+        src = Source_Builder(make_ingress_source(2, 20)).build()
+        mp = graph.add_source(src)
+        mp.add(Map_Builder(lambda t, _m=mul: TupleT(t.key, t.value * _m)).build())
+        pipes.append(mp)
+    pipes[0].merge(pipes[1], pipes[2]).add_sink(
+        Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    tot = sum(range(1, 21))
+    assert acc.value == 2 * tot * (1 + 100 + 10_000)
+    assert acc.count == 3 * 2 * 20
+
+
+def test_split_of_split():
+    """Nested splits: the reference's multi-split graph tests."""
+    leaves = [GlobalSum() for _ in range(3)]
+    graph = PipeGraph("nested_split")
+    src = Source_Builder(make_ingress_source(3, 30)).build()
+    mp = graph.add_source(src)
+    mp.split(lambda t: 0 if t.value % 2 == 0 else 1, 2)
+    evens = mp.select(0).add(Map_Builder(lambda t: t).build())
+    evens.split(lambda t: 0 if t.value % 4 == 0 else 1, 2)
+    evens.select(0).add_sink(Sink_Builder(make_sum_sink(leaves[0])).build())
+    evens.select(1).add_sink(Sink_Builder(make_sum_sink(leaves[1])).build())
+    mp.select(1).add_sink(Sink_Builder(make_sum_sink(leaves[2])).build())
+    graph.run()
+    vals = range(1, 31)
+    assert leaves[0].value == 3 * sum(v for v in vals if v % 4 == 0)
+    assert leaves[1].value == 3 * sum(v for v in vals if v % 2 == 0 and v % 4)
+    assert leaves[2].value == 3 * sum(v for v in vals if v % 2 == 1)
+
+
+def test_chain_sink_after_shuffle():
+    """chain_sink fuses the sink with the preceding filter stage."""
+    acc = GlobalSum()
+    graph = PipeGraph("chain_sink")
+    src = Source_Builder(make_ingress_source(2, 25)).with_parallelism(2).build()
+    f = Filter_Builder(lambda t: t.value > 5).with_parallelism(3).build()
+    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(3).build()
+    mp = graph.add_source(src)
+    mp.add(f)
+    mp.chain_sink(sink)
+    assert graph.get_num_threads() == 2 + 3  # sink fused with filter
+    graph.run()
+    assert acc.value == 2 * sum(v for v in range(1, 26) if v > 5)

@@ -23,8 +23,10 @@ from ..runtime.emitters import BasicEmitter
 
 
 def arity(fn: Callable) -> int:
-    """Number of positional parameters of a user functor; drives the
-    riched/non-riched variant choice (``wf/meta.hpp`` overload sets)."""
+    """Number of REQUIRED positional parameters of a user functor; drives
+    the riched/non-riched variant choice (``wf/meta.hpp`` overload sets).
+    Parameters with defaults don't count: ``lambda t, _m=x: ...`` is the
+    common closure idiom and must not be mistaken for a riched variant."""
     try:
         sig = inspect.signature(fn)
     except (TypeError, ValueError):
@@ -33,7 +35,8 @@ def arity(fn: Callable) -> int:
     for p in sig.parameters.values():
         if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
                       inspect.Parameter.POSITIONAL_OR_KEYWORD):
-            n += 1
+            if p.default is inspect.Parameter.empty:
+                n += 1
         elif p.kind == inspect.Parameter.VAR_POSITIONAL:
             return -1  # *args: caller decides
     return n
