@@ -76,7 +76,7 @@ def main() -> None:
         combine=lambda a, b: {"value": a["value"] + b["value"]},
         key_extractor="key",
         win_len=WIN_US, slide_len=SLIDE_US, win_type=WinType.TB,
-        num_win_per_batch=32, name="bench_ffat")
+        num_win_per_batch=64, key_capacity=N_KEYS, name="bench_ffat")
     op.build_replicas()
     rep = op.replicas[0]
 

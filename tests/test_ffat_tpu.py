@@ -182,3 +182,26 @@ def test_ffat_tpu_noncommutative_minmax():
     graph.run()
     raw = {k: v for k, v in raw.items() if v is not None}
     assert res == raw
+
+
+def test_ffat_tpu_device_mode_segmentation():
+    """The accelerator path (in-program sort/segmentation) must produce
+    exactly the host path's windows; CPU CI otherwise only exercises the
+    host branch. Forcing _host_seg=False runs the device branch on the CPU
+    backend."""
+    import windflow_tpu.tpu.ffat_tpu as ft
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_US,
+                                SLIDE_US, False, sum_or_none)
+    orig_init = ft.FfatTPUReplica.__init__
+
+    def forced(self, op, idx):
+        orig_init(self, op, idx)
+        self._host_seg = False
+
+    ft.FfatTPUReplica.__init__ = forced
+    try:
+        coll = run_ffat_tpu(WIN_US, SLIDE_US, win_type_cb=False)
+    finally:
+        ft.FfatTPUReplica.__init__ = orig_init
+    assert coll.dups == 0
+    assert coll.results == expected

@@ -10,10 +10,14 @@ device ring and firing watermark-complete windows through a per-key FlatFAT
 
 TPU-first redesign:
 - the control plane runs on HOST METADATA ONLY: keys and timestamps are
-  already host-side on ``BatchTPU``, so segmentation (sort order, segment
-  runs), per-key pane bookkeeping, window-fire decisions and eviction lists
-  are all numpy — no D2H of data at all (the reference pays a D2H of its
-  unique arrays every batch, ``ffat_replica_gpu.hpp:945-988``);
+  already host-side on ``BatchTPU``, so per-key pane bookkeeping,
+  window-fire decisions and eviction lists are numpy — no D2H of data at
+  all (the reference pays a D2H of its unique arrays every batch,
+  ``ffat_replica_gpu.hpp:945-988``). Segmentation (sort order + run
+  detection) is backend-dependent: precomputed with numpy on the CPU
+  backend (where the XLA program competes with the host for cores), and
+  computed IN-PROGRAM on accelerators (where device work overlaps the
+  host control plane);
 - the data plane is ONE jitted XLA program per batch:
     lift(columns) -> gather(sort order) -> segmented associative scan with
     the user combine -> gather segment tails -> scatter-combine into the
@@ -23,9 +27,10 @@ TPU-first redesign:
     iterative range queries for up to W_cap fired windows (each walks
     <= 2 log F nodes with ordered left/right accumulators, safe for
     non-commutative combines) -> leaf eviction;
-- all shapes are static per (cap, s_cap, K_cap, F) bucket; key capacity and
-  ring length grow by doubling with a device-side rebuild (the reference
-  resizes its pending-pane ring on demand, ``ffat_replica_gpu.hpp:219-260``).
+- all shapes are static per (cap, K_cap, F, segmentation-mode) bucket;
+  key capacity and ring length grow by doubling with a device-side rebuild
+  (the reference resizes its pending-pane ring on demand,
+  ``ffat_replica_gpu.hpp:219-260``).
 
 Window semantics match the CPU ``Ffat_Windows``: pane = gcd(win, slide)
 time units (TB) or one tuple (CB, leaf = per-key arrival index); TB windows
@@ -111,13 +116,27 @@ class FfatTPUReplica(TPUReplicaBase):
         self.tvalid = None  # (K_cap, 2F) bool
         self._step_cache: Dict[Any, Any] = {}
         self._last_fields = None  # small field sample for data-less firing
+        self.__host_seg = None  # resolved lazily: backend init is costly
+
+    @property
+    def _host_seg(self) -> bool:
+        if self.__host_seg is None:
+            import jax
+            self.__host_seg = jax.default_backend() == "cpu"
+        return self.__host_seg
+
+    @_host_seg.setter
+    def _host_seg(self, v) -> None:
+        self.__host_seg = v
 
     # ==================================================================
     # the per-batch device program
     # ==================================================================
-    def _make_step(self, cap: int, s_cap: int):
+    def _make_step(self, cap: int):
         import jax
         import jax.numpy as jnp
+
+        host_seg = self._host_seg
 
         lift = self.op.lift
         combine = self.op.combine
@@ -172,11 +191,32 @@ class FfatTPUReplica(TPUReplicaBase):
                                  length - len1)
             return comb_valid(v1, r1, v2, r2)
 
-        def step(fields, order, same_prev, seg_pos, seg_slots, seg_leaves,
-                 seg_mask, trees, tvalid, fire_slots, fire_starts, fire_lens,
-                 fire_mask, evict_slots, evict_leaves, evict_mask):
-            # 1. lift + segmented inclusive scan per (key, leaf) run
+        def step(fields, slots, leaves_phys, live, h_order, h_same, h_end,
+                 h_flat, trees, tvalid,
+                 fire_slots, fire_starts, fire_lens, fire_mask,
+                 evict_slots, evict_leaves, evict_mask):
+            # 1. lift + sort + segmented scan. WHERE the sort happens is
+            # backend-dependent: on accelerators it runs in-program (device
+            # work overlaps the host control plane); on the CPU backend the
+            # program shares cores with the host, so numpy precomputes the
+            # order/run metadata (h_* args; the device-mode args are dummies
+            # then, and vice versa — the cache key includes the mode).
             vals = lift(fields)
+            if host_seg:
+                order = h_order
+                same_prev = h_same
+                is_end = h_end
+                flat_idx = h_flat
+            else:
+                big = jnp.int32(K_cap * F)  # sentinel: late + padding
+                composite = jnp.where(live, slots * F + leaves_phys, big)
+                order = jnp.argsort(composite, stable=True)
+                sc = composite[order]
+                same_prev = jnp.concatenate(
+                    [jnp.zeros((1,), bool), sc[1:] == sc[:-1]])
+                is_end = jnp.concatenate(
+                    [sc[1:] != sc[:-1], jnp.ones((1,), bool)]) & (sc < big)
+                flat_idx = slots[order] * NNODES + (F + leaves_phys[order])
             svals = tmap(lambda a: a[order], vals)
 
             def seg_op(a, b):
@@ -187,17 +227,15 @@ class FfatTPUReplica(TPUReplicaBase):
                 return out, sa & same_b
 
             scanned, _ = jax.lax.associative_scan(seg_op, (svals, same_prev))
-            seg_vals = tmap(lambda a: a[seg_pos], scanned)  # (s_cap,)
 
             # 2. scatter-combine segment tails into forest leaves
-            flat_idx = seg_slots * NNODES + (F + seg_leaves)
-            safe_idx = jnp.where(seg_mask, flat_idx, OOB)
-            gather_idx = jnp.where(seg_mask, flat_idx, 0)
-            leaf_valid = tvalid.reshape(-1)[gather_idx] & seg_mask
+            safe_idx = jnp.where(is_end, flat_idx, OOB)
+            gather_idx = jnp.where(is_end, flat_idx, 0)
+            leaf_valid = tvalid.reshape(-1)[gather_idx] & is_end
             cur_leaves = tmap(lambda t: t.reshape(-1)[gather_idx], trees)
-            merged_all = combine(cur_leaves, seg_vals)
+            merged_all = combine(cur_leaves, scanned)
             new_leaves = tmap(lambda m, sv: jnp.where(leaf_valid, m, sv),
-                              merged_all, seg_vals)
+                              merged_all, scanned)
             trees = tmap(
                 lambda t, nl: t.reshape(-1).at[safe_idx].set(
                     nl, mode="drop").reshape(t.shape),
@@ -374,46 +412,34 @@ class FfatTPUReplica(TPUReplicaBase):
             lv_slots = slots[live]
             np.maximum.at(self.max_leaf, lv_slots, leaves[live])
 
-        # host segmentation: lexsort by (slot, leaf) — composite integer
-        # keys would overflow with epoch-microsecond pane ids; late rows
-        # sort into one front run (slot/leaf -1) excluded from tails
-        o_slots = np.where(live, slots, -1)
-        o_leaves = np.where(live, leaves, -1)
-        order = np.lexsort((o_leaves, o_slots))
-        ssl = o_slots[order]
-        sle = o_leaves[order]
-        same = np.r_[False, (ssl[1:] == ssl[:-1]) & (sle[1:] == sle[:-1])]
-        same_prev = same
-        is_end = np.r_[~same[1:], True]
-        seg_pos_all = np.nonzero(is_end)[0]
-        seg_live = live[order][seg_pos_all]
-        seg_pos_h = seg_pos_all[seg_live]
-        n_segs = len(seg_pos_h)
-        seg_slots_h = slots[order][seg_pos_h]
-        seg_leaves_h = leaves[order][seg_pos_h]
-
         cap = batch.capacity
-        # s_cap pinned to the batch capacity: a per-batch bucket from the
-        # observed segment count churned XLA recompiles (segments <= n <= cap
-        # always holds)
-        s_cap = cap
-        order_p = np.zeros(cap, dtype=np.int32)
-        order_p[:n] = order
-        same_p = np.zeros(cap, dtype=bool)
-        same_p[:n] = same_prev
-        segpos_p = np.zeros(s_cap, dtype=np.int32)
-        segpos_p[:n_segs] = seg_pos_h
-        segslot_p = np.zeros(s_cap, dtype=np.int32)
-        segslot_p[:n_segs] = seg_slots_h
-        segleaf_p = np.zeros(s_cap, dtype=np.int32)
-        segleaf_p[:n_segs] = seg_leaves_h % self.F
-        segmask_p = np.zeros(s_cap, dtype=bool)
-        segmask_p[:n_segs] = True
+        slots_p = np.zeros(cap, dtype=np.int32)
+        slots_p[:n] = slots
+        leafphys_p = np.zeros(cap, dtype=np.int32)
+        leafphys_p[:n] = leaves % self.F
+        live_p = np.zeros(cap, dtype=bool)
+        live_p[:n] = live
+        if self._host_seg:
+            big = np.int64(self.K_cap) * self.F
+            composite = np.where(live_p, slots_p.astype(np.int64) * self.F
+                                 + leafphys_p, big)
+            order_p = np.argsort(composite, kind="stable").astype(np.int32)
+            sc = composite[order_p]
+            same_p = np.r_[False, sc[1:] == sc[:-1]]
+            end_p = np.r_[sc[1:] != sc[:-1], True] & (sc < big)
+            flat_p = (slots_p[order_p].astype(np.int32) * (2 * self.F)
+                      + self.F + leafphys_p[order_p])
+            # device-mode inputs shrink to dummies in host mode
+            slots_p = np.zeros(1, dtype=np.int32)
+            leafphys_p = np.zeros(1, dtype=np.int32)
+            live_p = np.zeros(1, dtype=bool)
+        else:
+            order_p = same_p = end_p = flat_p = None
 
         frontier = (max(0, batch.wm - op.lateness) // op.pane_len
                     if op.win_type is WinType.TB else None)
-        self._run_step(batch.fields, batch.wm, cap, s_cap, order_p, same_p,
-                       segpos_p, segslot_p, segleaf_p, segmask_p, frontier)
+        self._run_step(batch.fields, batch.wm, cap, slots_p, leafphys_p,
+                       live_p, order_p, same_p, end_p, flat_p, frontier)
 
     # ------------------------------------------------------------------
     def _fireable(self, frontier, partial: bool):
@@ -442,20 +468,34 @@ class FfatTPUReplica(TPUReplicaBase):
                 break
         return specs
 
-    def _run_step(self, fields, wm, cap, s_cap, order_p, same_p, segpos_p,
-                  segslot_p, segleaf_p, segmask_p, frontier,
+    def _run_step(self, fields, wm, cap, slots_p, leafphys_p, live_p,
+                  order_p, same_p, end_p, flat_p, frontier,
                   partial: bool = False) -> None:
         import jax
 
+        if self._host_seg and order_p is None:
+            # data-less firing in host mode: no segments
+            order_p = np.zeros(cap, dtype=np.int32)
+            same_p = np.zeros(cap, dtype=bool)
+            end_p = np.zeros(cap, dtype=bool)
+            flat_p = np.zeros(cap, dtype=np.int32)
+            slots_p = np.zeros(1, dtype=np.int32)
+            leafphys_p = np.zeros(1, dtype=np.int32)
+            live_p = np.zeros(1, dtype=bool)
+        elif order_p is None:
+            order_p = np.zeros(1, dtype=np.int32)
+            same_p = np.zeros(1, dtype=bool)
+            end_p = np.zeros(1, dtype=bool)
+            flat_p = np.zeros(1, dtype=np.int32)
         first = True
         while True:
             specs = self._fireable(frontier, partial)
             if not first and not specs:
                 break
-            ckey = (cap, s_cap, self.K_cap, self.F)
+            ckey = (cap, self.K_cap, self.F, self._host_seg)
             step = self._step_cache.get(ckey)
             if step is None:
-                step = self._step_cache[ckey] = self._make_step(cap, s_cap)
+                step = self._step_cache[ckey] = self._make_step(cap)
             W = self.W_cap
             E = max(1, W * self.slide_units)
             f_slots = np.zeros(W, dtype=np.int32)
@@ -481,13 +521,16 @@ class FfatTPUReplica(TPUReplicaBase):
                     e_mask[ei] = True
                     ei += 1
             self.trees, self.tvalid, qr, qv = step(
-                fields, order_p, same_p, segpos_p, segslot_p, segleaf_p,
-                segmask_p, self.trees, self.tvalid, f_slots, f_starts,
-                f_lens, f_mask, e_slots, e_leaves, e_mask)
+                fields, slots_p, leafphys_p, live_p, order_p, same_p, end_p,
+                flat_p, self.trees, self.tvalid,
+                f_slots, f_starts, f_lens, f_mask, e_slots, e_leaves, e_mask)
             self.stats.device_programs_run += 1
             if specs:
                 self._emit_windows(wm, specs, wids, qr, qv)
-            segmask_p = np.zeros(s_cap, dtype=bool)  # applied exactly once
+            # segments are applied exactly once per batch (shape-preserving
+            # resets: a shape flip here would force a re-trace)
+            live_p = np.zeros(live_p.shape, dtype=bool)
+            end_p = np.zeros(end_p.shape, dtype=bool)
             first = False
             if len(specs) < self.W_cap:
                 break
@@ -520,14 +563,11 @@ class FfatTPUReplica(TPUReplicaBase):
         if self.trees is None or self._last_fields is None:
             return
         cap = next(iter(self._last_fields.values())).shape[0]
-        s_cap = 8
-        self._run_step(self._last_fields, self.cur_wm, cap, s_cap,
+        self._run_step(self._last_fields, self.cur_wm, cap,
                        np.zeros(cap, dtype=np.int32),
-                       np.zeros(cap, dtype=bool),
-                       np.zeros(s_cap, dtype=np.int32),
-                       np.zeros(s_cap, dtype=np.int32),
-                       np.zeros(s_cap, dtype=np.int32),
-                       np.zeros(s_cap, dtype=bool), frontier, partial)
+                       np.zeros(cap, dtype=np.int32),
+                       np.zeros(cap, dtype=bool), None, None, None, None,
+                       frontier, partial)
 
     def on_punctuation(self, wm: int) -> None:
         if self.op.win_type is WinType.TB:
