@@ -25,9 +25,7 @@ program runs unchanged on a real multi-chip TPU slice.
 
 from __future__ import annotations
 
-import functools
 import math
-from typing import Tuple
 
 import numpy as np
 

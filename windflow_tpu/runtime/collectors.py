@@ -147,7 +147,6 @@ class OrderingCollector(BasicCollector):
         for c, buf in enumerate(self._bufs):
             for i, m in enumerate(buf):
                 heapq.heappush(heap, (self._key(m), c, i, m))
-            buf_len = len(buf)
         while heap:
             _, _, _, m = heapq.heappop(heap)
             self.next_node.handle_msg(0, m)

@@ -26,8 +26,6 @@ from ..basic import (DEFAULT_WM_AMOUNT, DEFAULT_WM_INTERVAL_USEC,
 from ..message import Batch, Single, make_punctuation
 from .channel import Port
 
-MAX_WM = (1 << 63) - 1
-
 
 class BasicEmitter:
     """Base: owns destination ports, optional micro-batching, per-destination

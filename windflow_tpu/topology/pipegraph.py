@@ -126,12 +126,6 @@ class PipeGraph:
         for s in self._stages:
             self._make_workers(s)
 
-    def _edge_emitter_kind(self, producer: Stage, consumer: Stage):
-        first = consumer.first_op
-        routing = first.input_routing
-        obs = producer.last_op.output_batch_size
-        return routing, obs
-
     def _wire_edge(self, producer: Stage, branch: Optional[int],
                    consumer: Stage) -> None:
         """Create one emitter per producer replica targeting all consumer
