@@ -208,3 +208,57 @@ def test_window_thread_count_composite():
     graph.add_source(src).add(paw).add_sink(Sink_Builder(coll.sink).build())
     assert graph.get_num_threads() == 1 + 2 + 3 + 1
     graph.run()
+
+
+def test_paned_windows_cb_deterministic():
+    """CB paned windows are legal in DETERMINISTIC mode (single source =>
+    deterministic pane assignment); completes the {PAW} x {CB} cell of the
+    reference's win_tests matrix."""
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_CB,
+                                SLIDE_CB, True, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("paw_cb", ExecutionMode.DETERMINISTIC,
+                      TimePolicy.EVENT_TIME)
+    src = Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN)).build()
+    paw = (Paned_Windows_Builder(lambda ws: sum(w.value for w in ws),
+                                 lambda vals: sum(vals))
+           .with_key_by(lambda t: t.key).with_cb_windows(WIN_CB, SLIDE_CB)
+           .with_parallelism(2, 3).build())
+    graph.add_source(src).add(paw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_mapreduce_windows_cb_deterministic():
+    """CB MapReduce windows in DETERMINISTIC mode ({MRW} x {CB} cell).
+    Note: MAP partitions tuples by ts %% p even for CB windows
+    (reference window_replica.hpp:286 uses the timestamp)."""
+    expected = expected_windows(model_seqs(N_KEYS, STREAM_LEN), WIN_CB,
+                                SLIDE_CB, True, sum_agg)
+    coll = WinCollector()
+    graph = PipeGraph("mrw_cb", ExecutionMode.DETERMINISTIC,
+                      TimePolicy.EVENT_TIME)
+    src = Source_Builder(make_keyed_event_source(N_KEYS, STREAM_LEN)).build()
+    mrw = (MapReduce_Windows_Builder(lambda ws: sum(w.value for w in ws),
+                                     lambda vals: sum(vals))
+           .with_key_by(lambda t: t.key).with_cb_windows(WIN_CB, SLIDE_CB)
+           .with_parallelism(3, 2).build())
+    graph.add_source(src).add(mrw).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.dups == 0
+    assert coll.results == expected
+
+
+def test_paned_cb_rejected_in_default_mode():
+    import pytest
+    graph = PipeGraph("paw_cb_bad", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    src = Source_Builder(make_keyed_event_source(1, 2)).build()
+    paw = (Paned_Windows_Builder(lambda ws: 0, lambda vs: 0)
+           .with_key_by(lambda t: t.key).with_cb_windows(8, 4)
+           .with_parallelism(2, 2).build())
+    graph.add_source(src).add(paw).add_sink(
+        Sink_Builder(lambda r: None).build())
+    with pytest.raises(WindFlowError):
+        graph.run()

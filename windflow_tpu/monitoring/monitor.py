@@ -164,22 +164,44 @@ class MonitoringServer:
                 else:
                     rows = []
                     for g, st in snap["reports"].items():
-                        ops = "".join(
-                            f"<tr><td>{o['name']}</td><td>{o['kind']}</td>"
-                            f"<td>{o['parallelism']}</td>"
-                            f"<td>{sum(r['Inputs_received'] for r in o['replicas'])}</td>"
-                            f"<td>{sum(r['Outputs_sent'] for r in o['replicas'])}</td></tr>"
-                            for o in st.get("Operators", []))
+                        ops = []
+                        for o in st.get("Operators", []):
+                            reps = o["replicas"]
+                            tin = sum(r["Inputs_received"] for r in reps)
+                            tout = sum(r["Outputs_sent"] for r in reps)
+                            tput = sum(r.get("Throughput_tuples_sec", 0)
+                                       for r in reps)
+                            svc = max((r.get("Service_time_usec", 0)
+                                       for r in reps), default=0)
+                            dev = sum(r.get("Device_programs_run", 0)
+                                      for r in reps)
+                            ign = sum(r.get("Inputs_ignored", 0)
+                                      for r in reps)
+                            ops.append(
+                                f"<tr><td>{o['name']}</td><td>{o['kind']}"
+                                f"</td><td>{o['parallelism']}</td>"
+                                f"<td>{tin}</td><td>{tout}</td><td>{ign}</td>"
+                                f"<td>{tput:,.0f}</td><td>{svc:.1f}</td>"
+                                f"<td>{dev}</td></tr>")
                         rows.append(
                             f"<h2>{g} <small>[{st.get('Mode')}] threads="
                             f"{st.get('Threads')} dropped="
                             f"{st.get('Dropped_tuples')}</small></h2>"
-                            f"<table border=1 cellpadding=4><tr><th>op</th>"
-                            f"<th>kind</th><th>par</th><th>in</th><th>out</th>"
-                            f"</tr>{ops}</table>"
-                            f"<pre>{snap['diagrams'].get(g, '')}</pre>")
+                            f"<table border=1 cellpadding=4 "
+                            f"style='border-collapse:collapse'>"
+                            f"<tr><th>op</th><th>kind</th><th>par</th>"
+                            f"<th>in</th><th>out</th><th>ignored</th>"
+                            f"<th>tuples/s</th><th>svc µs</th>"
+                            f"<th>device progs</th></tr>"
+                            + "".join(ops) + "</table>"
+                            f"<details><summary>dataflow graph</summary>"
+                            f"<pre>{snap['diagrams'].get(g, '')}</pre>"
+                            f"</details>")
                     self._send(200,
-                               "<html><body><h1>windflow_tpu dashboard</h1>"
+                               "<html><head><meta http-equiv='refresh' "
+                               "content='2'><title>windflow_tpu</title>"
+                               "</head><body style='font-family:monospace'>"
+                               "<h1>windflow_tpu dashboard</h1>"
                                + "".join(rows) + "</body></html>",
                                "text/html")
 
