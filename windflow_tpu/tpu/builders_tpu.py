@@ -52,12 +52,23 @@ class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
     def __init__(self, pred: Callable) -> None:
         super().__init__(pred)
         self._schema: Optional[TupleSchema] = None
+        self._state_init: Any = None
+
+    def with_state(self, initial_state: Any) -> "Filter_TPU_Builder":
+        """Per-key device state: switches the predicate to
+        ``pred(row, state) -> (keep, state)``."""
+        self._state_init = initial_state
+        return self
 
     def build(self) -> Filter_TPU:
+        if self._state_init is not None and self._key_extractor is None:
+            raise WindFlowError("Filter_TPU_Builder: with_state requires "
+                                "with_key_by")
         return self._finish(Filter_TPU(self._func, self._name,
                                        self._parallelism, self._routing,
                                        self._key_extractor,
-                                       self._output_batch_size, self._schema))
+                                       self._output_batch_size, self._schema,
+                                       self._state_init))
 
 
 class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):

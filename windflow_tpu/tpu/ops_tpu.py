@@ -68,6 +68,25 @@ class TPUReplicaBase(BasicReplica):
         self.stats.device_batches_out += 1
         self.emitter.emit_device_batch(batch)
 
+    def emit_compacted(self, batch: BatchTPU, out_fields, order, count
+                       ) -> None:
+        """Emit a compaction result: device columns reordered keep-first,
+        host ts/keys reordered to match (shared by the filter paths)."""
+        new_size = int(count)
+        order_np = np.asarray(order)
+        self.stats.inputs_ignored += batch.size - new_size
+        ts2 = batch.ts_host[order_np]
+        keys2 = None
+        if batch.host_keys is not None:
+            keys_list = list(batch.host_keys)
+            keys_arr = keys_list + [None] * (batch.capacity - len(keys_list))
+            keys2 = [keys_arr[j] for j in order_np[:new_size]]
+        nb = BatchTPU(out_fields, ts2, new_size, batch.schema, batch.wm,
+                      keys2)
+        nb.stream_tag = batch.stream_tag
+        if new_size > 0:
+            self._emit_batch(nb)
+
     # per-batch keys: host metadata when staged keyed, else the device key
     # column named by a string key extractor
     def batch_keys(self, batch: BatchTPU):
@@ -160,94 +179,235 @@ class MapTPUReplica(TPUReplicaBase):
         self._emit_batch(batch.with_fields(out))
 
 
-class StatefulMapTPUReplica(TPUReplicaBase):
-    """Device-resident keyed state table + masked scan in arrival order."""
+class _KeyedStateScan:
+    """Shared keyed device-state machinery for stateful Map/Filter.
 
-    def __init__(self, op, idx):
-        super().__init__(op, idx)
+    The reference runs one CUDA worker per distinct key walking its linked
+    chain serially (``map_gpu.hpp:80-102``). The TPU shape of that idea: a
+    (K_cap x M) GRID scan — rows scatter to (key slot, per-key position),
+    the scan walks the per-key POSITION axis (M = max tuples of one key in
+    the batch) while ``vmap`` processes all keys in parallel each step.
+    Sequential work is the per-key chain depth, not the batch size; state
+    lives in a device-resident (K_cap,) table pytree between batches.
+    """
+
+    def __init__(self, replica, func, state_init, filter_mode: bool) -> None:
+        self.replica = replica
+        self.func = func
+        self.state_init = state_init
+        self.filter_mode = filter_mode
+        self.slot_of_key: Dict[Any, int] = {}
+        self.table_capacity = 64
+        self.table = None  # pytree of (table_capacity, ...) arrays
+        self._cache: Dict[Any, Any] = {}
+
+    # -- device program ----------------------------------------------------
+    def _make(self, M: int, KB: int):
+        """The program works on the BATCH-LOCAL key set: grids are
+        (KB x M) where KB = distinct keys in this batch (bucketed), and the
+        global state table contributes only its touched rows (gathered in,
+        scattered back) — per-batch cost is bounded by the batch, not by
+        the stream's total key cardinality."""
         import jax
         import jax.numpy as jnp
 
-        self.slot_of_key: Dict[Any, int] = {}
-        self.table_capacity = 64
-        self.table = None  # pytree of (table_capacity,)-arrays
+        KM = KB * M
+        func = self.func
+        filter_mode = self.filter_mode
+        tmap = jax.tree_util.tree_map
 
-        func = op.func
+        def bwhere(ok, new, old):
+            shaped = ok.reshape(ok.shape + (1,) * (new.ndim - ok.ndim))
+            return jnp.where(shaped, new, old).astype(old.dtype)
 
-        def run(fields, ts_unused, slots, size, table):
-            valid = jnp.arange(next(iter(fields.values())).shape[0]) < size
+        def run(fields, grid_idx, valid, touched, touched_mask, table):
+            T_cap = next(iter(jax.tree_util.tree_leaves(table))).shape[0]
+            tsafe = jnp.where(touched_mask, touched, 0)
+            sub = tmap(lambda a: a[tsafe], table)  # (KB, ...)
+            safe = jnp.where(valid, grid_idx, KM)
+            grids = {f: jnp.zeros((KM,), v.dtype).at[safe].set(
+                         v, mode="drop").reshape(KB, M)
+                     for f, v in fields.items()}
+            gmask = jnp.zeros((KM,), bool).at[safe].set(
+                True, mode="drop").reshape(KB, M)
+            vfunc = jax.vmap(func)
 
-            def body(tbl, x):
-                row, slot, ok = x
-                state = jax.tree_util.tree_map(lambda a: a[slot], tbl)
-                new_row, new_state = func(row, state)
-                tbl = jax.tree_util.tree_map(
-                    lambda a, v: a.at[slot].set(
-                        jnp.where(ok, v, a[slot]).astype(a.dtype)),
-                    tbl, new_state)
-                out = {k: jnp.where(ok, new_row[k], row[k]) for k in row}
-                return tbl, out
+            def body(tbl, xs):
+                col, ok = xs  # col: {f: (KB,)}, ok: (KB,)
+                out_col, new_state = vfunc(col, tbl)
+                tbl = tmap(lambda o, nw: bwhere(ok, nw, o), tbl, new_state)
+                return tbl, out_col
 
-            table2, outs = jax.lax.scan(body, table, (fields, slots, valid))
-            return table2, outs
+            cols = {f: g.T for f, g in grids.items()}  # (M, KB)
+            sub2, outs = jax.lax.scan(body, sub, (cols, gmask.T))
+            tscatter = jnp.where(touched_mask, touched, T_cap)
+            table2 = tmap(
+                lambda a, nw: a.at[tscatter].set(nw, mode="drop"),
+                table, sub2)
+            # gather outputs back to arrival positions: grid (slot, within)
+            slot = grid_idx // M
+            within = jnp.where(valid, grid_idx % M, 0)
+            row_flat = within * KB + jnp.minimum(slot, KB - 1)
+            if filter_mode:
+                keep = outs.reshape(-1)[row_flat]  # (cap,) bool
+                keep = keep & valid
+                order = jnp.argsort(~keep, stable=True)
+                out = {k: v[order] for k, v in fields.items()}
+                return out, order, jnp.sum(keep), table2
+            out_rows = {f: (o.reshape(M * KB, -1)[row_flat].reshape(
+                            fields[f].shape)
+                            if o.ndim > 2 else o.reshape(-1)[row_flat])
+                        for f, o in outs.items()}
+            return out_rows, table2
 
-        self._jitted = jax.jit(run)
+        return jax.jit(run)
 
-    def _ensure_table(self, n_keys_needed: int, sample_batch: BatchTPU):
+    # -- host side ---------------------------------------------------------
+    def _ensure_table(self, n_keys_needed: int) -> None:
         import jax
         import jax.numpy as jnp
 
         if self.table is None:
-            init = self.op.state_init
+            init = self.state_init
             self.table = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
                                    dtype=jnp.asarray(v).dtype), init)
         while n_keys_needed > self.table_capacity:
             self.table_capacity *= 2
-            init = self.op.state_init
+            self._cache.clear()
             old = self.table
             fresh = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
-                                   dtype=jnp.asarray(v).dtype), init)
+                                   dtype=jnp.asarray(v).dtype),
+                self.state_init)
             self.table = jax.tree_util.tree_map(
                 lambda f, o: f.at[:o.shape[0]].set(o), fresh, old)
 
-    def process_device_batch(self, batch: BatchTPU) -> None:
-        import jax
+    def _global_slot(self, k) -> int:
+        sl = self.slot_of_key.get(k)
+        if sl is None:
+            sl = self.slot_of_key[k] = len(self.slot_of_key)
+        return sl
 
-        slots = np.zeros(batch.capacity, dtype=np.int32)
-        for i, k in enumerate(self.batch_keys(batch)):
-            s = self.slot_of_key.get(k)
-            if s is None:
-                s = self.slot_of_key[k] = len(self.slot_of_key)
-            slots[i] = s
-        self._ensure_table(len(self.slot_of_key), batch)
-        table2, outs = self._jitted(batch.fields, None,
-                                    jax.device_put(slots), batch.size,
-                                    self.table)
+    def grid_meta(self, batch: BatchTPU):
+        """(grid_idx, valid, touched, touched_mask, M, KB): batch-local
+        grid positions, the touched global table rows, and the grid
+        bucket sizes."""
+        n = batch.size
+        cap = batch.capacity
+        keys = self.replica.batch_keys(batch)
+        keys_arr = np.asarray(keys)
+        if n and keys_arr.dtype.kind in "iu":
+            # vectorized: one dict lookup per DISTINCT key
+            uniq, lslots = np.unique(keys_arr, return_inverse=True)
+            touched_list = [self._global_slot(int(k)) for k in uniq]
+        else:
+            local_of_global: Dict[int, int] = {}
+            lslots = np.zeros(n, dtype=np.int64)
+            touched_list = []
+            for i, k in enumerate(keys):
+                sl = self._global_slot(k)
+                ll = local_of_global.get(sl)
+                if ll is None:
+                    ll = local_of_global[sl] = len(local_of_global)
+                    touched_list.append(sl)
+                lslots[i] = ll
+        self._ensure_table(len(self.slot_of_key))
+        order0 = np.argsort(lslots, kind="stable")
+        ss = lslots[order0]
+        seg_start = np.r_[True, ss[1:] != ss[:-1]] if n else np.zeros(0, bool)
+        first_of = np.nonzero(seg_start)[0]
+        grp = np.cumsum(seg_start) - 1
+        within = np.empty(n, dtype=np.int64)
+        within[order0] = np.arange(n) - first_of[grp]
+        max_depth = int(within.max()) + 1 if n else 1
+        M = 1
+        while M < max_depth:
+            M <<= 1
+        KB = 1
+        while KB < max(1, len(touched_list)):
+            KB <<= 1
+        grid_idx = np.zeros(cap, dtype=np.int32)
+        grid_idx[:n] = lslots * M + within
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = True
+        touched = np.zeros(KB, dtype=np.int32)
+        touched[:len(touched_list)] = touched_list
+        touched_mask = np.zeros(KB, dtype=bool)
+        touched_mask[:len(touched_list)] = True
+        return grid_idx, valid, touched, touched_mask, M, KB
+
+    def program(self, M: int, KB: int):
+        ckey = (M, KB)
+        prog = self._cache.get(ckey)
+        if prog is None:
+            prog = self._cache[ckey] = self._make(M, KB)
+        return prog
+
+
+class StatefulMapTPUReplica(TPUReplicaBase):
+    """Per-key device state via the grid scan (see _KeyedStateScan)."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self.engine = _KeyedStateScan(self, op.func, op.state_init, False)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        grid_idx, valid, touched, tmask, M, KB = self.engine.grid_meta(batch)
+        prog = self.engine.program(M, KB)
+        outs, table2 = prog(batch.fields, grid_idx, valid, touched, tmask,
+                            self.engine.table)
         self.stats.device_programs_run += 1
-        self.table = table2
+        self.engine.table = table2
         self._emit_batch(batch.with_fields(outs))
+
+
+class StatefulFilterTPUReplica(TPUReplicaBase):
+    """Keyed-state predicate + compaction in one program (the reference's
+    stateful Filter_GPU, ``filter_gpu.hpp:331-335``)."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self.engine = _KeyedStateScan(self, op.pred, op.state_init, True)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        grid_idx, valid, touched, tmask, M, KB = self.engine.grid_meta(batch)
+        prog = self.engine.program(M, KB)
+        out, order, count, table2 = prog(batch.fields, grid_idx, valid,
+                                         touched, tmask, self.engine.table)
+        self.stats.device_programs_run += 1
+        self.engine.table = table2
+        self.emit_compacted(batch, out, order, count)
 
 
 # ---------------------------------------------------------------------------
 # Filter_TPU
 # ---------------------------------------------------------------------------
 class Filter_TPU(TPUOperatorBase):
-    """``pred(fields) -> bool column``; batch compacts in place."""
+    """Stateless: ``pred(fields) -> bool column``; the batch compacts.
+    Stateful (``state_init`` given): ``pred(row, state) -> (keep, state)``
+    over scalars with per-key device state (grid scan)."""
 
     def __init__(self, pred: Callable, name: str = "filter_tpu",
                  parallelism: int = 1,
                  input_routing: RoutingMode = RoutingMode.FORWARD,
                  key_extractor=None, output_batch_size: int = 0,
-                 schema: Optional[TupleSchema] = None) -> None:
-        super().__init__(name, parallelism, input_routing, key_extractor,
-                         output_batch_size, schema)
+                 schema: Optional[TupleSchema] = None,
+                 state_init: Any = None) -> None:
+        if state_init is not None and key_extractor is None:
+            raise WindFlowError(f"{name}: stateful Filter_TPU requires a "
+                                "key extractor (KEYBY)")
+        super().__init__(name, parallelism,
+                         RoutingMode.KEYBY if state_init is not None
+                         else input_routing,
+                         key_extractor, output_batch_size, schema)
         self.pred = pred
+        self.state_init = state_init
 
     def build_replicas(self) -> None:
-        self.replicas = [FilterTPUReplica(self, i)
-                         for i in range(self.parallelism)]
+        cls = (StatefulFilterTPUReplica if self.state_init is not None
+               else FilterTPUReplica)
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
 
 
 class FilterTPUReplica(TPUReplicaBase):
@@ -270,20 +430,7 @@ class FilterTPUReplica(TPUReplicaBase):
     def process_device_batch(self, batch: BatchTPU) -> None:
         out, order, count = self._jitted(batch.fields, batch.size)
         self.stats.device_programs_run += 1
-        new_size = int(count)
-        order_np = np.asarray(order)
-        dropped = batch.size - new_size
-        self.stats.inputs_ignored += dropped
-        ts2 = batch.ts_host[order_np]
-        keys2 = None
-        if batch.host_keys is not None:
-            keys_arr = list(batch.host_keys) + \
-                [None] * (batch.capacity - len(batch.host_keys))
-            keys2 = [keys_arr[j] for j in order_np[:new_size]]
-        nb = BatchTPU(out, ts2, new_size, batch.schema, batch.wm, keys2)
-        nb.stream_tag = batch.stream_tag
-        if new_size > 0:
-            self._emit_batch(nb)
+        self.emit_compacted(batch, out, order, count)
 
     # empty batches are dropped entirely (the reference shrinks to zero and
     # forwards; dropping is equivalent because watermarks flow via puncts)
