@@ -295,3 +295,28 @@ def test_stateful_map_table_growth_many_keys():
     graph.add_source(src).add(m).add_sink(Sink_Builder(sink).build())
     graph.run()
     assert acc == {k: 20 for k in range(n_keys)}
+
+
+def test_global_reduce_tpu():
+    """No key extractor: each batch folds to exactly one tuple (the
+    reference's thrust::reduce case)."""
+    outs = []
+    graph = PipeGraph("tpu_gred", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+    src = (Source_Builder(make_ingress_source(3, 50))
+           .with_output_batch_size(16).build())
+    red = Reduce_TPU_Builder(
+        lambda a, b: {"value": a["value"] + b["value"]}).build()
+    import threading
+    lock = threading.Lock()
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                outs.append(t.value)
+
+    graph.add_source(src).add(red).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    assert sum(outs) == 3 * sum(range(1, 51))
+    # 150 tuples in batches of <=16 -> one output per batch
+    assert len(outs) >= (3 * 50) // 16

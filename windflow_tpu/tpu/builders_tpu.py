@@ -79,8 +79,7 @@ class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         self._schema: Optional[TupleSchema] = None
 
     def build(self) -> Reduce_TPU:
-        if self._key_extractor is None:
-            raise WindFlowError("Reduce_TPU_Builder: withKeyBy is mandatory")
+        # without withKeyBy this is the GLOBAL per-batch reduce
         return self._finish(Reduce_TPU(self._func, self._key_extractor,
                                        self._name, self._parallelism,
                                        self._output_batch_size, self._schema))

@@ -440,25 +440,71 @@ class FilterTPUReplica(TPUReplicaBase):
 # Reduce_TPU
 # ---------------------------------------------------------------------------
 class Reduce_TPU(TPUOperatorBase):
-    """Per-batch keyed combine: one output tuple per distinct key per batch
-    (``combine(fields_a, fields_b) -> fields``, associative+commutative).
-    With ``key_extractor=None``... not allowed: KEYBY is mandatory like the
-    reference's keyed variant; a global per-batch reduce is the keyed case
-    with a constant key."""
+    """Per-batch combine (``combine(fields_a, fields_b) -> fields``,
+    associative+commutative, ``API:78-80``). Keyed (key extractor given):
+    one output per distinct key per batch (reference ``reduce_by_key``,
+    ``reduce_gpu.hpp:245-251``). Global (no key): the whole batch folds to
+    ONE output tuple (reference ``thrust::reduce``,
+    ``reduce_gpu.hpp:269-272``)."""
 
-    def __init__(self, combine: Callable, key_extractor,
+    def __init__(self, combine: Callable, key_extractor=None,
                  name: str = "reduce_tpu", parallelism: int = 1,
                  output_batch_size: int = 0,
                  schema: Optional[TupleSchema] = None) -> None:
-        if key_extractor is None:
-            raise WindFlowError(f"{name}: Reduce_TPU requires a key extractor")
-        super().__init__(name, parallelism, RoutingMode.KEYBY, key_extractor,
+        routing = (RoutingMode.KEYBY if key_extractor is not None
+                   else RoutingMode.FORWARD)
+        super().__init__(name, parallelism, routing, key_extractor,
                          output_batch_size, schema)
         self.combine = combine
 
     def build_replicas(self) -> None:
-        self.replicas = [ReduceTPUReplica(self, i)
-                         for i in range(self.parallelism)]
+        cls = (ReduceTPUReplica if self.key_extractor is not None
+               else GlobalReduceTPUReplica)
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
+
+
+class GlobalReduceTPUReplica(TPUReplicaBase):
+    """Whole-batch fold to one tuple via a masked pairwise tree reduction
+    (log2(cap) fused halving passes — associativity is the contract)."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        import jax
+        import jax.numpy as jnp
+
+        combine = op.combine
+
+        def run(fields, size):
+            n = next(iter(fields.values())).shape[0]
+            valid = jnp.arange(n) < size
+            cur = fields
+            vcur = valid
+            length = n
+            while length > 1:
+                half = length // 2
+                a = {k: v[:half] for k, v in cur.items()}
+                b = {k: v[half:half * 2] for k, v in cur.items()}
+                va, vb = vcur[:half], vcur[half:half * 2]
+                merged = combine(a, b)
+                cur = {k: jnp.where(va & vb, merged.get(k, b[k]),
+                                    jnp.where(va, a[k], b[k]))
+                       for k in cur}
+                vcur = va | vb
+                length = half
+            return {k: v[:1] for k, v in cur.items()}
+
+        self._jitted = jax.jit(run)
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        if batch.size == 0:
+            return
+        out = self._jitted(batch.fields, batch.size)
+        self.stats.device_programs_run += 1
+        ts = np.array([int(batch.ts_host[:batch.size].max())],
+                      dtype=np.int64)
+        nb = BatchTPU(out, ts, 1, batch.schema, batch.wm)
+        nb.stream_tag = batch.stream_tag
+        self._emit_batch(nb)
 
 
 class ReduceTPUReplica(TPUReplicaBase):
