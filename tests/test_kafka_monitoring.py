@@ -165,3 +165,5 @@ def test_monitoring_reports_over_tcp(monkeypatch):
     with open("/tmp/wf_test_logs/traced_stats.json") as f:
         dumped = json.load(f)
     assert dumped["Threads"] == graph.get_num_threads()
+    with open("/tmp/wf_test_logs/traced_diagram.dot") as f:
+        assert "->" in f.read()

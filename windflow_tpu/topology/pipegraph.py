@@ -356,10 +356,16 @@ class PipeGraph:
         }
 
     def dump_stats(self, log_dir: str = "log") -> str:
+        """JSON stats + the dataflow diagram (the reference renders a PDF at
+        wait_end, ``wf/pipegraph.hpp:732-734``; we write the dot source —
+        render with ``dot -Tpdf`` where graphviz is installed)."""
         os.makedirs(log_dir, exist_ok=True)
         path = os.path.join(log_dir, f"{self.name}_stats.json")
         with open(path, "w") as f:
             json.dump(self.get_stats(), f, indent=2)
+        with open(os.path.join(log_dir, f"{self.name}_diagram.dot"),
+                  "w") as f:
+            f.write(self.to_dot() + "\n")
         return path
 
     # -- diagram (reference builds a Graphviz PDF/SVG) ---------------------
