@@ -135,12 +135,13 @@ def test_kafka_requires_client_for_real_brokers():
 # ---------------------------------------------------------------------------
 # monitoring
 # ---------------------------------------------------------------------------
-def test_monitoring_reports_over_tcp(monkeypatch):
+def test_monitoring_reports_over_tcp(monkeypatch, tmp_path):
     server = MonitoringServer()
+    log_dir = str(tmp_path / "logs")  # fresh per run: no stale artifacts
     monkeypatch.setenv("WF_TRACING_ENABLED", "1")
     monkeypatch.setenv("WF_DASHBOARD_MACHINE", server.host)
     monkeypatch.setenv("WF_DASHBOARD_PORT", str(server.port))
-    monkeypatch.setenv("WF_LOG_DIR", "/tmp/wf_test_logs")
+    monkeypatch.setenv("WF_LOG_DIR", log_dir)
     acc = GlobalSum()
     graph = PipeGraph("traced")
     src = Source_Builder(make_ingress_source(2, 50)).build()
@@ -161,9 +162,9 @@ def test_monitoring_reports_over_tcp(monkeypatch):
     assert stats["PipeGraph_name"] == "traced"
     assert any(o["kind"] == "Map" for o in stats["Operators"])
     # the stats log dump also happened (wait_end with tracing enabled)
-    assert os.path.exists("/tmp/wf_test_logs/traced_stats.json")
-    with open("/tmp/wf_test_logs/traced_stats.json") as f:
+    assert os.path.exists(os.path.join(log_dir, "traced_stats.json"))
+    with open(os.path.join(log_dir, "traced_stats.json")) as f:
         dumped = json.load(f)
     assert dumped["Threads"] == graph.get_num_threads()
-    with open("/tmp/wf_test_logs/traced_diagram.dot") as f:
+    with open(os.path.join(log_dir, "traced_diagram.dot")) as f:
         assert "->" in f.read()

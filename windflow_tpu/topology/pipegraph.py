@@ -360,17 +360,19 @@ class PipeGraph:
         wait_end, ``wf/pipegraph.hpp:732-734``; we write the dot source —
         render with ``dot -Tpdf`` where graphviz is installed)."""
         os.makedirs(log_dir, exist_ok=True)
-        path = os.path.join(log_dir, f"{self.name}_stats.json")
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in self.name) or "pipegraph"
+        path = os.path.join(log_dir, f"{safe}_stats.json")
         with open(path, "w") as f:
             json.dump(self.get_stats(), f, indent=2)
-        with open(os.path.join(log_dir, f"{self.name}_diagram.dot"),
-                  "w") as f:
+        with open(os.path.join(log_dir, f"{safe}_diagram.dot"), "w") as f:
             f.write(self.to_dot() + "\n")
         return path
 
     # -- diagram (reference builds a Graphviz PDF/SVG) ---------------------
     def to_dot(self) -> str:
-        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;",
+        gname = self.name.replace('"', "'")
+        lines = [f'digraph "{gname}" {{', "  rankdir=LR;",
                  "  node [shape=box, style=rounded];"]
         for s in self._stages:
             label = s.describe().replace('"', "'")
