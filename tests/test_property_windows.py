@@ -1,0 +1,113 @@
+"""Property-based stress of the windowing semantics: random window shapes
+and timestamp sequences checked against the independent model, for the CPU
+engine and the FFAT device operator (both must agree with the model and
+therefore with each other)."""
+
+from hypothesis import given, settings, strategies as st
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder, PipeGraph,
+                          Sink_Builder, Source_Builder, TimePolicy)
+from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+from common import TupleT, WinCollector, expected_windows
+
+
+@st.composite
+def window_case(draw):
+    win = draw(st.integers(1, 12))
+    slide = draw(st.integers(1, 12))
+    n = draw(st.integers(1, 40))
+    # monotone per-key ts with random gaps (gaps create empty windows)
+    steps = draw(st.lists(st.integers(1, 9), min_size=n, max_size=n))
+    ts = []
+    t = 0
+    for s in steps:
+        ts.append(t)
+        t += s
+    vals = draw(st.lists(st.integers(-5, 9), min_size=n, max_size=n))
+    return win, slide, list(zip(vals, ts))
+
+
+@settings(max_examples=25, deadline=None)
+@given(window_case())
+def test_keyed_windows_tb_matches_model(case):
+    win, slide, rows = case
+    expected = expected_windows({0: rows}, win, slide, False,
+                                lambda vs: sum(vs))
+    coll = WinCollector()
+    graph = PipeGraph("prop_kw", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for v, ts in rows:
+            shipper.push_with_timestamp(TupleT(0, v, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    kw = (Keyed_Windows_Builder(lambda ws: sum(w.value for w in ws))
+          .with_key_by(lambda t: t.key).with_tb_windows(win, slide).build())
+    graph.add_source(Source_Builder(src).build()).add(kw).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    assert coll.results == expected
+
+
+@settings(max_examples=10, deadline=None)
+@given(window_case())
+def test_ffat_tpu_tb_matches_model(case):
+    win, slide, rows = case
+    expected = expected_windows({0: rows}, win, slide, False,
+                                lambda vs: sum(vs) if vs else None)
+    results = {}
+    graph = PipeGraph("prop_fat", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for v, ts in rows:
+            shipper.push_with_timestamp(TupleT(0, v, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b: {"value": a["value"] + b["value"]})
+          .with_key_by("key").with_tb_windows(win, slide)
+          .with_num_win_per_batch(4).build())
+
+    def sink(r):
+        if r is not None:
+            results[(r["key"], r["wid"])] = (r["value"] if r["valid"]
+                                             else None)
+
+    graph.add_source(Source_Builder(src).with_output_batch_size(8).build()) \
+        .add(op).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    assert results == expected
+
+
+def test_probabilistic_windows_conservation():
+    """KSlack mode with real disorder feeding keyed windows: the window sums
+    over DELIVERED tuples plus dropped tuples conserve the stream."""
+    import random
+    rng = random.Random(3)
+    rows = []
+    for i in range(400):
+        ts = max(0, i * 50 - rng.randint(0, 400))
+        rows.append((1, ts))
+    graph = PipeGraph("prob_win", ExecutionMode.PROBABILISTIC,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i, (v, ts) in enumerate(rows):
+            shipper.push_with_timestamp(TupleT(0, v, ts), ts)
+            # monotone: based on the un-jittered index position
+            shipper.set_next_watermark(max(0, i * 50 - 400))
+
+    coll = WinCollector()
+    kw = (Keyed_Windows_Builder(lambda ws: sum(w.value for w in ws))
+          .with_key_by(lambda t: t.key)
+          .with_tb_windows(1000, 1000).build())  # tumbling: no double count
+    graph.add_source(Source_Builder(src).build()).add(kw).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    delivered = sum(v for v in coll.results.values())
+    dropped = graph.get_num_dropped_tuples()
+    assert delivered + dropped == len(rows)
