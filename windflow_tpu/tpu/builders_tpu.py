@@ -79,6 +79,13 @@ class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         self._schema: Optional[TupleSchema] = None
 
     def build(self) -> Reduce_TPU:
+        from ..basic import RoutingMode
+        if self._routing is RoutingMode.BROADCAST:
+            # the op derives its routing from the key extractor (keyed
+            # shuffle or forward); silently ignoring withBroadcast would
+            # mislead (the reference reduce has no broadcast form either)
+            raise WindFlowError("Reduce_TPU_Builder: withBroadcast is not "
+                                "supported (use withKeyBy or forward)")
         # without withKeyBy this is the GLOBAL per-batch reduce
         return self._finish(Reduce_TPU(self._func, self._key_extractor,
                                        self._name, self._parallelism,
