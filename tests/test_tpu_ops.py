@@ -320,3 +320,41 @@ def test_global_reduce_tpu():
     assert sum(outs) == 3 * sum(range(1, 51))
     # 150 tuples in batches of <=16 -> one output per batch
     assert len(outs) >= (3 * 50) // 16
+
+
+def test_global_reduce_tpu_odd_capacity():
+    """Regression: the pairwise-halving fold must not drop the odd tail.
+    Batches with non-power-of-two capacity arise whenever an upstream op
+    (e.g. Ffat_Windows_TPU) emits capacity == num_win_per_batch."""
+    import numpy as np
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.ops_tpu import Reduce_TPU
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    op = Reduce_TPU(lambda a, b: {"value": a["value"] + b["value"]})
+    op.build_replicas()
+    rep = op.replicas[0]
+    outs = []
+
+    class Cap:
+        stats = None
+
+        def emit_device_batch(self, b):
+            outs.append(int(b.fields["value"][0]))
+
+        def set_stats(self, s):
+            pass
+
+    rep.emitter = Cap()
+    schema = TupleSchema({"value": np.int32})
+    for cap in (3, 5, 7, 10, 13):
+        vals = jnp.arange(1, cap + 1, dtype=jnp.int32)
+        b = BatchTPU({"value": vals},
+                     np.arange(cap, dtype=np.int64), cap, schema)
+        rep.process_device_batch(b)
+        assert outs[-1] == cap * (cap + 1) // 2, (cap, outs[-1])
+    # partial batch: only `size` rows participate
+    b = BatchTPU({"value": jnp.arange(1, 11, dtype=jnp.int32)},
+                 np.arange(10, dtype=np.int64), 6, schema)
+    rep.process_device_batch(b)
+    assert outs[-1] == 21

@@ -477,9 +477,19 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
         def run(fields, size):
             n = next(iter(fields.values())).shape[0]
             valid = jnp.arange(n) < size
+            # Pad up to a power of two so the halving loop never drops an
+            # odd tail (upstream ops such as Ffat_Windows_TPU emit batches
+            # whose capacity is num_win_per_batch — any user value).
+            m = 1 << max(0, n - 1).bit_length()
+            if m != n:
+                pad = m - n
+                fields = {k: jnp.concatenate(
+                    [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+                    for k, v in fields.items()}
+                valid = jnp.concatenate([valid, jnp.zeros(pad, bool)])
             cur = fields
             vcur = valid
-            length = n
+            length = m
             while length > 1:
                 half = length // 2
                 a = {k: v[:half] for k, v in cur.items()}
