@@ -106,16 +106,108 @@ def test_tpu_exit_then_split_then_merge():
     assert acc.count == 4 * 50
 
 
-def test_split_after_tpu_requires_host_exit():
+def test_split_directly_after_tpu_callable():
+    """Device-plane split (reference splitting_emitter_gpu): Source ->
+    Map_TPU -> split -> {Filter_TPU -> sink, sink} with randomized degrees;
+    the randomized-checksum harness of split_tests_gpu."""
+    rng = random.Random(21)
+    last = None
+    for _ in range(3):
+        accA, accB = GlobalSum(), GlobalSum()
+        graph = PipeGraph("tpu_split_direct")
+        src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+               .with_parallelism(rand_degree(rng))
+               .with_output_batch_size(16).build())
+        mp = graph.add_source(src)
+        mp.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] + 1})
+               .with_parallelism(rand_degree(rng)).build())
+        mp.split(lambda t: 0 if t.value % 2 == 0 else 1, 2)
+        b0 = mp.select(0)
+        b0.add(Filter_TPU_Builder(lambda f: f["value"] % 3 != 0)
+               .with_parallelism(rand_degree(rng)).build())
+        b0.add_sink(Sink_Builder(make_sum_sink(accA)).build())
+        b1 = mp.select(1)
+        b1.add_sink(Sink_Builder(make_sum_sink(accB)).build())
+        graph.run()
+        cur = (accA.value, accA.count, accB.value, accB.count)
+        if last is None:
+            last = cur
+        else:
+            assert cur == last
+    vals = [v + 1 for v in range(1, STREAM_LEN + 1)]
+    evens = [v for v in vals if v % 2 == 0]
+    odds = [v for v in vals if v % 2 == 1]
+    assert last[0] == N_KEYS * sum(v for v in evens if v % 3 != 0)
+    assert last[2] == N_KEYS * sum(odds)
+
+
+def test_split_after_tpu_field_routing():
+    """Vectorized branch routing by a device-computed int field (one-column
+    D2H, no per-tuple Python)."""
+    accA, accB = GlobalSum(), GlobalSum()
+    graph = PipeGraph("tpu_split_field")
+    src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+           .with_output_batch_size(16).build())
+    mp = graph.add_source(src)
+    mp.add(Map_TPU_Builder(
+        lambda f: {**f, "branch": f["value"] % 2}).build())
+    mp.split("branch", 2)
+    mp.select(0).add_sink(Sink_Builder(make_sum_sink(accA)).build())
+    b1 = mp.select(1)
+    b1.add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 7}).build())
+    b1.add_sink(Sink_Builder(make_sum_sink(accB)).build())
+    graph.run()
+    evens = [v for v in range(1, STREAM_LEN + 1) if v % 2 == 0]
+    odds = [v for v in range(1, STREAM_LEN + 1) if v % 2 == 1]
+    assert accA.value == N_KEYS * sum(evens)
+    assert accB.value == N_KEYS * 7 * sum(odds)
+    assert accA.count == N_KEYS * len(evens)
+    assert accB.count == N_KEYS * len(odds)
+
+
+def test_split_after_tpu_multi_select_and_keyed_branch():
+    """A callable may select SEVERAL branches per tuple (reference
+    splitting logic contract); one branch re-shards keyed into a device
+    reduce."""
+    import threading
+    accB = GlobalSum()
+    red_acc = {}
+    lock = threading.Lock()
+
+    def red_sink(t):
+        if t is not None:
+            with lock:
+                red_acc[t.key] = red_acc.get(t.key, 0) + t.value
+
+    graph = PipeGraph("tpu_split_multi")
+    src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
+           .with_output_batch_size(16).build())
+    mp = graph.add_source(src)
+    mp.add(Map_TPU_Builder(lambda f: dict(f)).with_key_by("key").build())
+    mp.split(lambda t: (0, 1) if t.value % 10 == 0 else 1, 2)
+    b0 = mp.select(0)
+    b0.add(Reduce_TPU_Builder(
+        lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+        .with_key_by("key").with_parallelism(2).build())
+    b0.add_sink(Sink_Builder(red_sink).build())
+    mp.select(1).add_sink(Sink_Builder(make_sum_sink(accB)).build())
+    graph.run()
+    tens = [v for v in range(1, STREAM_LEN + 1) if v % 10 == 0]
+    assert red_acc == {k: sum(tens) for k in range(N_KEYS)}
+    assert accB.value == N_KEYS * sum(range(1, STREAM_LEN + 1))
+    assert accB.count == N_KEYS * STREAM_LEN
+
+
+def test_split_field_routing_out_of_range():
     import pytest
     from windflow_tpu import WindFlowError
-    graph = PipeGraph("tpu_split_bad")
-    src = (Source_Builder(make_ingress_source(1, 4))
+    graph = PipeGraph("tpu_split_oob")
+    src = (Source_Builder(make_ingress_source(1, 8))
            .with_output_batch_size(4).build())
     mp = graph.add_source(src)
-    mp.add(Map_TPU_Builder(lambda f: f).build())
-    mp.split(lambda t: 0, 2)
+    mp.add(Map_TPU_Builder(lambda f: {**f, "branch": f["value"]}).build())
+    mp.split("branch", 2)
     mp.select(0).add_sink(Sink_Builder(lambda t: None).build())
     mp.select(1).add_sink(Sink_Builder(lambda t: None).build())
-    with pytest.raises(WindFlowError, match="split"):
+    with pytest.raises(WindFlowError, match="branch index"):
         graph.run()

@@ -18,7 +18,17 @@ from typing import Any, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "wfruntime.cpp")
-_SO = os.path.join(_HERE, "_wfruntime.so")
+
+
+def _so_path() -> str:
+    """Cache keyed on a hash of the source (not mtimes: fresh-checkout
+    mtimes are arbitrary and could silently shadow the source with a stale
+    prebuilt binary). The .so is never committed."""
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_HERE, f"_wfruntime-{h}.so")
 
 _lock = threading.Lock()
 _lib = None  # CDLL: queue functions (GIL released while blocking)
@@ -26,16 +36,25 @@ _pylib = None  # PyDLL: encoder functions (called with the GIL held)
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
+def _build(so: str) -> Optional[str]:
     inc = sysconfig.get_paths()["include"]
+    tmp = so + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           f"-I{inc}", _SRC, "-o", _SO]
+           f"-I{inc}", _SRC, "-o", tmp]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=240)
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"native build failed: {e}"
     if r.returncode != 0:
         return f"native build failed: {r.stderr[-800:]}"
+    os.replace(tmp, so)  # atomic publish for concurrent processes
+    import glob
+    for stale in glob.glob(os.path.join(_HERE, "_wfruntime-*")):
+        if os.path.abspath(stale) != os.path.abspath(so):
+            try:
+                os.unlink(stale)  # superseded hashes / orphaned .tmp files
+            except OSError:
+                pass
     return None
 
 
@@ -46,17 +65,15 @@ def _load() -> bool:
             return True
         if _build_error is not None:
             return False
-        if not os.path.exists(_SO) or (os.path.getmtime(_SO)
-                                       < os.path.getmtime(_SRC)):
-            err = _build()
-            if err and not os.path.exists(_SO):
+        so = _so_path()
+        if not os.path.exists(so):
+            err = _build(so)
+            if err is not None:
                 _build_error = err
                 return False
-            # a failed rebuild with a prebuilt .so on disk (e.g. fresh
-            # checkout mtimes, no toolchain) falls back to loading it
         try:
-            lib = ctypes.CDLL(_SO)
-            pylib = ctypes.PyDLL(_SO)
+            lib = ctypes.CDLL(so)
+            pylib = ctypes.PyDLL(so)
         except OSError as e:
             _build_error = str(e)
             return False

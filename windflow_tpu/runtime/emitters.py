@@ -249,16 +249,25 @@ class SplittingEmitter(BasicEmitter):
             e.set_ports(ports[off:off + e.num_dests])
             off += e.num_dests
 
+    def _check_branch(self, s: int) -> int:
+        if not 0 <= s < len(self.inner):
+            from ..basic import WindFlowError
+            raise WindFlowError(
+                f"splitting logic returned branch index {s} outside "
+                f"[0, {len(self.inner)})")
+        return s
+
     def emit(self, payload: Any, ts: int, wm: int,
              msg_id: Optional[int] = None) -> None:
         sel = self.splitting_logic(payload)
         if sel is None:
             return
         if isinstance(sel, int):
-            self.inner[sel].emit(payload, ts, wm, msg_id)
+            self.inner[self._check_branch(sel)].emit(payload, ts, wm, msg_id)
         else:
             for s in sel:
-                self.inner[s].emit(payload, ts, wm, msg_id)
+                self.inner[self._check_branch(s)].emit(payload, ts, wm,
+                                                       msg_id)
 
     def propagate_punctuation(self, wm: int) -> None:
         for e in self.inner:

@@ -118,10 +118,13 @@ class MultiPipe:
         return self.chain(op)
 
     # ------------------------------------------------------------------
-    def split(self, splitting_logic: Callable, n_branches: int) -> "MultiPipe":
+    def split(self, splitting_logic, n_branches: int) -> "MultiPipe":
         """Split the pipe into ``n_branches`` children; ``splitting_logic``
         maps a tuple to a branch index (or an iterable of indices, or None to
-        drop). ``wf/multipipe.hpp:1178-1256``."""
+        drop). ``wf/multipipe.hpp:1178-1256``. A string names a tuple field
+        holding the branch index — after a TPU operator this routes from one
+        column D2H with no per-tuple Python (``split_gpu``,
+        ``wf/multipipe.hpp:698-708``)."""
         self._check_open("split")
         if n_branches < 2:
             raise WindFlowError("split requires at least 2 branches")

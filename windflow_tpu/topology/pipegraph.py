@@ -120,7 +120,19 @@ class PipeGraph:
                         raise WindFlowError(
                             f"split stage {s.describe()}: branches {missing} "
                             f"have no operators")
-                    se = SplittingEmitter(s.split_logic, ems, self.execution_mode)
+                    logic = s.split_logic
+                    if getattr(s.last_op, "is_tpu", False):
+                        from ..tpu.emitters_tpu import TPUSplittingEmitter
+                        se: BasicEmitter = TPUSplittingEmitter(
+                            logic, ems, self.execution_mode)
+                    else:
+                        if isinstance(logic, str):
+                            field = logic
+                            logic = (lambda t, _f=field:
+                                     t[_f] if isinstance(t, dict)
+                                     else getattr(t, _f))
+                        se = SplittingEmitter(logic, ems,
+                                              self.execution_mode)
                     r.set_emitter(se)
         # collectors + workers
         for s in self._stages:
@@ -309,15 +321,6 @@ class PipeGraph:
             raise WindFlowError("empty PipeGraph: no sources")
         for s in self._stages:
             if s.is_split:
-                if getattr(s.last_op, "is_tpu", False):
-                    # per-tuple splitting logic runs on the host; split after
-                    # a device operator needs an explicit exit (CPU Map)
-                    # first — same restriction as the reference's split_gpu
-                    # needing a host transfer (wf/splitting_emitter_gpu.hpp)
-                    raise WindFlowError(
-                        f"cannot split directly after TPU operator "
-                        f"{s.last_op.name!r}; insert a CPU operator to exit "
-                        "the device plane first")
                 missing = [b for b, st in enumerate(s.split_branches)
                            if st is None]
                 if missing:

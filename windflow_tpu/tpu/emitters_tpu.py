@@ -129,6 +129,28 @@ class TPUBroadcastEmitter(BasicEmitter):
             self.ports[d].send(out)
 
 
+def gather_sub_batch(batch: BatchTPU, idx: np.ndarray,
+                     host_keys=None) -> BatchTPU:
+    """Gather ``idx`` rows of a device batch into a new (smaller) device
+    batch without leaving HBM: one XLA gather per column from a
+    host-computed index vector. Shared by the keyed re-shard and the
+    device-plane splitting emitter."""
+    import jax
+
+    cap = bucket_capacity(idx.size)
+    gather = np.zeros(cap, dtype=np.int32)
+    gather[:idx.size] = idx
+    gidx = jax.device_put(gather)
+    sub_fields = {k: v[gidx] for k, v in batch.fields.items()}
+    ts2 = batch.ts_host[gather]
+    if host_keys is None and batch.host_keys is not None:
+        host_keys = [batch.host_keys[j] for j in idx]
+    keys2 = host_keys
+    sub = BatchTPU(sub_fields, ts2, idx.size, batch.schema, batch.wm, keys2)
+    sub.stream_tag = batch.stream_tag
+    return sub
+
+
 class TPUKeyByEmitter(BasicEmitter):
     """TPU->TPU keyed re-shard: per-destination sub-batches gathered on
     device with host-computed index vectors."""
@@ -167,21 +189,107 @@ class TPUKeyByEmitter(BasicEmitter):
             idx = np.nonzero(dests == d)[0]
             if idx.size == 0:
                 continue
-            cap = bucket_capacity(idx.size)
-            gather = np.zeros(cap, dtype=np.int32)
-            gather[:idx.size] = idx
-            gidx = jax.device_put(gather)
-            sub_fields = {k: v[gidx] for k, v in batch.fields.items()}
-            ts2 = batch.ts_host[gather]
-            keys2 = [host_keys[j] for j in idx]
-            sub = BatchTPU(sub_fields, ts2, idx.size, batch.schema, batch.wm,
-                           keys2)
-            sub.stream_tag = batch.stream_tag
+            sub = gather_sub_batch(batch, idx,
+                                   [host_keys[j] for j in idx])
             sub.id = self._next_ids[d]
             self._next_ids[d] += 1
             if self.stats is not None:
                 self.stats.outputs_sent += sub.size
             self.ports[d].send(sub)
+
+
+class TPUSplittingEmitter(BasicEmitter):
+    """Device-plane split (reference ``wf/splitting_emitter_gpu.hpp:48-341``,
+    wired at ``wf/multipipe.hpp:698-708``): routes per-branch sub-batches
+    after a TPU operator. The reference transfers the whole batch to host
+    and re-stages per branch; here the data stays in HBM — only the routing
+    decision touches the host, and each branch receives a device gather of
+    its rows (same shape as the keyed re-shard).
+
+    ``splitting_logic`` forms:
+    - a string field name: the int32/int64 column holds the branch index
+      per row (vectorized: one column D2H, no per-tuple Python);
+    - a callable payload -> int | iterable[int] | None (reference
+      contract): rows are materialized once per batch to evaluate it.
+    """
+
+    def __init__(self, splitting_logic, inner_emitters: List[BasicEmitter],
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT) -> None:
+        super().__init__(sum(e.num_dests for e in inner_emitters), 0,
+                         execution_mode)
+        self.splitting_logic = splitting_logic
+        self.inner = inner_emitters
+
+    def set_stats(self, stats) -> None:
+        self.stats = stats
+        for e in self.inner:
+            e.set_stats(stats)
+
+    def _branch_rows(self, batch: BatchTPU) -> List[np.ndarray]:
+        """Row indices per branch (host-side routing decision)."""
+        n_branches = len(self.inner)
+        logic = self.splitting_logic
+        if isinstance(logic, str):
+            col = np.asarray(batch.fields[logic])[:batch.size]
+            if self.stats is not None:
+                self.stats.device_bytes_d2h += int(col.nbytes)
+            if col.size and (col.min() < 0 or col.max() >= n_branches):
+                from ..basic import WindFlowError
+                raise WindFlowError(
+                    f"split field {logic!r} holds branch index "
+                    f"{int(col.min())}..{int(col.max())} outside "
+                    f"[0, {n_branches})")
+            return [np.nonzero(col == b)[0] for b in range(n_branches)]
+        sel: List[list] = [[] for _ in range(n_branches)]
+        if self.stats is not None:
+            self.stats.device_bytes_d2h += batch.nbytes()
+
+        def check(b: int) -> int:
+            if not 0 <= b < n_branches:
+                from ..basic import WindFlowError
+                raise WindFlowError(
+                    f"splitting logic returned branch index {b} outside "
+                    f"[0, {n_branches})")
+            return b
+
+        for i, (payload, _ts) in enumerate(batch.to_rows()):
+            s = logic(payload)
+            if s is None:
+                continue
+            if isinstance(s, int):
+                sel[check(s)].append(i)
+            else:
+                for b in s:
+                    sel[check(b)].append(i)
+        return [np.asarray(ix, dtype=np.int64) for ix in sel]
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        per_branch = self._branch_rows(batch)
+        for b, idx in enumerate(per_branch):
+            if idx.size == 0:
+                continue
+            if idx.size == batch.size:
+                # every row selected this branch: no gather needed (device
+                # arrays are immutable; copy only the metadata wrapper)
+                sub = batch.copy_for_dest()
+            else:
+                sub = gather_sub_batch(batch, idx)
+            self.inner[b].emit_device_batch(sub)
+
+    def propagate_punctuation(self, wm: int) -> None:
+        for e in self.inner:
+            e.propagate_punctuation(wm)
+
+    def flush(self) -> None:
+        for e in self.inner:
+            e.flush()
+
+    def send_eos_all(self) -> None:
+        for e in self.inner:
+            e.send_eos_all()
+
+    def eos_ports(self):
+        return [p for e in self.inner for p in e.eos_ports()]
 
 
 class TPUExitEmitter(BasicEmitter):
