@@ -23,6 +23,7 @@ class StatsRecord:
         "service_time_us", "eff_service_time_us",
         "device_batches_in", "device_batches_out",
         "device_bytes_h2d", "device_bytes_d2h", "device_programs_run",
+        "staging_pool_hits", "staging_pool_misses",
         "is_terminated", "_last_svc_start",
     )
 
@@ -44,6 +45,8 @@ class StatsRecord:
         self.device_bytes_h2d = 0
         self.device_bytes_d2h = 0
         self.device_programs_run = 0
+        self.staging_pool_hits = 0  # recycled staging buffers (ArrayPool)
+        self.staging_pool_misses = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
 
@@ -80,5 +83,7 @@ class StatsRecord:
             "Device_bytes_H2D": self.device_bytes_h2d,
             "Device_bytes_D2H": self.device_bytes_d2h,
             "Device_programs_run": self.device_programs_run,
+            "Staging_pool_hits": self.staging_pool_hits,
+            "Staging_pool_misses": self.staging_pool_misses,
             "isTerminated": self.is_terminated,
         }

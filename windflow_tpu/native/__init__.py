@@ -47,14 +47,23 @@ def _build(so: str) -> Optional[str]:
         return f"native build failed: {e}"
     if r.returncode != 0:
         return f"native build failed: {r.stderr[-800:]}"
-    os.replace(tmp, so)  # atomic publish for concurrent processes
+    try:
+        os.replace(tmp, so)  # atomic publish for concurrent processes
+    except OSError:
+        if not os.path.exists(so):  # a peer may have published already
+            return "native build failed: publish race lost and no .so"
     import glob
+    import time
     for stale in glob.glob(os.path.join(_HERE, "_wfruntime-*")):
-        if os.path.abspath(stale) != os.path.abspath(so):
-            try:
-                os.unlink(stale)  # superseded hashes / orphaned .tmp files
-            except OSError:
-                pass
+        if os.path.abspath(stale) == os.path.abspath(so):
+            continue
+        try:
+            if ".tmp" in os.path.basename(stale) and (
+                    time.time() - os.path.getmtime(stale) < 600):
+                continue  # possibly a live peer's in-progress build
+            os.unlink(stale)  # superseded hashes / orphaned .tmp files
+        except OSError:
+            pass
     return None
 
 

@@ -8,11 +8,10 @@ In the Python plane, message lifetime is garbage-collected and the hot
 allocations that matter are the COLUMNAR STAGING BUFFERS of the device
 boundary (one numpy array per field per staged batch). ``ArrayPool`` keeps
 free lists keyed by (dtype, capacity); the staging path acquires buffers
-from it and ``BatchTPU`` returns them once the device copy is complete
-(``jax.device_put(np_array)`` copies synchronously into the transfer
-buffer on CPU/TPU backends before returning control, so reuse after
-dispatch is safe; set WF_NO_RECYCLING=1 to disable, mirroring the
-reference's macro)."""
+from it and ``InFlightRecycler`` returns them once the device transfer is
+COMMITTED (``device_put``'s host read can complete asynchronously when
+dispatch queues deepen — premature reuse corrupts in-flight batches).
+Set WF_NO_RECYCLING=1 to disable, mirroring the reference's macro."""
 
 from __future__ import annotations
 
@@ -57,6 +56,66 @@ class ArrayPool:
             bucket = self._free[key]
             if len(bucket) < self.max_per_bucket:
                 bucket.append(arr)
+
+
+class InFlightRecycler:
+    """Safe staging-buffer recycling over async H2D transfers.
+
+    ``jax.device_put``'s read of the host buffer is DEFERRED: it executes
+    when the async dispatch queue reaches it, so a staging buffer must not
+    be touched until that read provably happened. ``jax.Array.is_ready()``
+    is NOT that signal — it reports True while the read is still queued
+    (verified empirically on the CPU backend: mutating the buffer after a
+    True ``is_ready()`` corrupts the device array). The only sound signal
+    is ``block_until_ready()`` returning, so this recycler keeps a bounded
+    FIFO of in-flight batches (device arrays + the host buffers that fed
+    them) and releases buffers to the ``ArrayPool`` ONLY on the blocking
+    pop once depth exceeds ``max_in_flight``. At depth N the transfer
+    being waited on was enqueued N batches ago — normally long done, so
+    the block is free; when it isn't, the stall is exactly the
+    backpressure the reference gets from an exhausted recycling pool
+    (``wf/recycling_gpu.hpp:68-88``, in-transit counter
+    ``wf/batch_gpu_t.hpp:66``; double-buffered staging
+    ``wf/keyby_emitter_gpu.hpp:443-505``)."""
+
+    def __init__(self, pool: ArrayPool, max_in_flight: int = 8,
+                 force: bool = False) -> None:
+        from collections import deque
+        self.pool = pool
+        self.max_in_flight = max_in_flight
+        self._q = deque()  # (device arrays tuple, host buffers list)
+        # Platform gate: the CPU backend's device_put may ALIAS the host
+        # buffer indefinitely (zero-copy) — no Python-visible point where
+        # reuse becomes safe, not even block_until_ready (verified: data
+        # corrupts after it under dispatch-queue pressure). Accelerator
+        # backends transfer with ImmutableUntilTransferCompletes
+        # semantics, where the array's ready future IS the release
+        # signal. ``force`` is for unit tests of the FIFO mechanics.
+        if force:
+            self.enabled = RECYCLING_ENABLED
+        else:
+            import jax
+            self.enabled = (RECYCLING_ENABLED
+                            and jax.default_backend() != "cpu")
+
+    def track(self, dev_arrays, host_buffers) -> None:
+        if not self.enabled:
+            return
+        self._q.append((tuple(dev_arrays), list(host_buffers)))
+        while len(self._q) > self.max_in_flight:
+            self._release_oldest()
+
+    def _release_oldest(self) -> None:
+        devs, bufs = self._q.popleft()
+        for d in devs:
+            d.block_until_ready()  # guarantees the host read is over
+        for b in bufs:
+            self.pool.release(b)
+
+    def drain(self) -> None:
+        """Release every tracked buffer (blocking; flush/EOS path)."""
+        while self._q:
+            self._release_oldest()
 
 
 class ObjectPool:

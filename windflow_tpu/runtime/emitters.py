@@ -101,6 +101,16 @@ class BasicEmitter:
              msg_id: Optional[int] = None) -> None:
         raise NotImplementedError
 
+    def emit_columns(self, cols, ts_arr, wm: int) -> None:
+        """Columnar push (SourceShipper.push_columns). Generic emitters
+        materialize dict rows; the device staging emitter overrides this
+        with a vectorized path that never touches individual tuples."""
+        names = list(cols)
+        pulled = [cols[n] for n in names]
+        for i in range(len(ts_arr)):
+            self.emit({n: p[i].item() for n, p in zip(names, pulled)},
+                      int(ts_arr[i]), wm)
+
     def propagate_punctuation(self, wm: int) -> None:
         """Flush partial batches then punctuate every destination; flushing
         first preserves per-channel watermark monotonicity."""
@@ -228,6 +238,16 @@ class BroadcastEmitter(BasicEmitter):
             self._batch = None
 
 
+def check_branch_index(s: int, n_branches: int) -> int:
+    """Shared split-branch validation (CPU and device planes)."""
+    if not 0 <= s < n_branches:
+        from ..basic import WindFlowError
+        raise WindFlowError(
+            f"splitting logic returned branch index {s} outside "
+            f"[0, {n_branches})")
+    return s
+
+
 class SplittingEmitter(BasicEmitter):
     """Tree emitter for MultiPipe::split: user logic selects branch index(es);
     one inner emitter per branch (``wf/splitting_emitter.hpp:48-341``)."""
@@ -249,25 +269,19 @@ class SplittingEmitter(BasicEmitter):
             e.set_ports(ports[off:off + e.num_dests])
             off += e.num_dests
 
-    def _check_branch(self, s: int) -> int:
-        if not 0 <= s < len(self.inner):
-            from ..basic import WindFlowError
-            raise WindFlowError(
-                f"splitting logic returned branch index {s} outside "
-                f"[0, {len(self.inner)})")
-        return s
-
     def emit(self, payload: Any, ts: int, wm: int,
              msg_id: Optional[int] = None) -> None:
         sel = self.splitting_logic(payload)
         if sel is None:
             return
+        n = len(self.inner)
         if isinstance(sel, int):
-            self.inner[self._check_branch(sel)].emit(payload, ts, wm, msg_id)
+            self.inner[check_branch_index(sel, n)].emit(payload, ts, wm,
+                                                        msg_id)
         else:
             for s in sel:
-                self.inner[self._check_branch(s)].emit(payload, ts, wm,
-                                                       msg_id)
+                self.inner[check_branch_index(s, n)].emit(payload, ts, wm,
+                                                          msg_id)
 
     def propagate_punctuation(self, wm: int) -> None:
         for e in self.inner:

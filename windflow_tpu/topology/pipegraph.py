@@ -79,8 +79,11 @@ class PipeGraph:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)
                 op.build_replicas()
-        # channels (one per consumer replica); the native C++ ring is used
-        # when requested and buildable (WF_NATIVE_CHANNELS=1)
+        # channels (one per consumer replica); the native C++ ring stays
+        # OPT-IN (WF_NATIVE_CHANNELS=1): measured 2026-07-29, the Python
+        # deque+Condition channel moves ~1.0M msg/s vs ~0.3M for the
+        # ctypes ring — per-call ctypes overhead dominates at message
+        # granularity, and inter-stage traffic is already batch-granular
         channel_cls = Channel
         if env_flag("WF_NATIVE_CHANNELS"):
             from ..native import NativeChannel, native_available
@@ -194,7 +197,8 @@ class PipeGraph:
             return TPUStageEmitter(n_dests, obs,
                                    getattr(first, "schema", None),
                                    first.key_extractor,
-                                   routing_name, self.execution_mode)
+                                   routing_name, self.execution_mode,
+                                   key_field=first.key_field)
         if p_tpu and c_tpu:  # device -> device
             from ..tpu.emitters_tpu import (TPUBroadcastEmitter,
                                             TPUForwardEmitter,

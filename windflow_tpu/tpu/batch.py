@@ -85,25 +85,59 @@ class BatchTPU(StreamMsg):
     @staticmethod
     def stage(rows: Sequence[Tuple[Any, int]], schema: TupleSchema,
               wm: int, keys: Optional[List[Any]] = None,
-              capacity: Optional[int] = None) -> "BatchTPU":
+              capacity: Optional[int] = None,
+              recycler=None) -> "BatchTPU":
         """CPU->TPU: columnarize and device_put (async dispatch; the
         reference's pinned staging + async H2D, ``keyby_emitter_gpu.hpp:
-        443-505``)."""
+        443-505``). With ``recycler`` (an ``InFlightRecycler``) the column
+        buffers come from its pool and are returned once the transfer is
+        committed — device_put's host read can complete asynchronously
+        once the dispatch queue deepens, so premature reuse corrupts
+        in-flight batches (the hazard the reference tracks with in-transit
+        counters, ``batch_gpu_t.hpp:66``)."""
         import jax
-        import jax.numpy as jnp
 
         cap = capacity or bucket_capacity(len(rows))
-        cols, ts = schema.to_columns(rows, cap)
-        # NOTE: the staging buffers are NOT recycled here — device_put's
-        # host-side read can complete asynchronously once the dispatch
-        # queue deepens, so reuse corrupts in-flight batches (empirically
-        # observed; this is the async-transfer hazard the reference tracks
-        # with its in-transit counters, batch_gpu_t.hpp:66). recycling.py's
-        # pool can be wired once completion callbacks are plumbed.
+        pooled = recycler is not None and recycler.enabled
+        cols, ts = schema.to_columns(rows, cap,
+                                     recycler.pool if pooled else None)
         dev_fields = {name: jax.device_put(col) for name, col in cols.items()}
+        if pooled:
+            recycler.track(dev_fields.values(), cols.values())
         # per-batch slot ids are computed by the consuming keyed operator
         # (TPUReplicaBase.batch_slots); host_keys is the canonical metadata
         return BatchTPU(dev_fields, ts, len(rows), schema, wm, keys)
+
+    @staticmethod
+    def stage_columns(cols: Dict[str, np.ndarray], ts: np.ndarray,
+                      schema: TupleSchema, wm: int,
+                      keys: Optional[List[Any]] = None,
+                      recycler=None) -> "BatchTPU":
+        """CPU->TPU from COLUMNS (push_columns fast path): pad each numpy
+        column to the capacity bucket and device_put — no per-tuple
+        Python at all."""
+        import jax
+
+        n = len(ts)
+        cap = bucket_capacity(n)
+        pooled = recycler is not None and recycler.enabled
+        dev_fields = {}
+        staged = []
+        for name, dt in schema.fields.items():
+            src = cols[name]
+            # one vectorized copy into a private buffer: the caller may
+            # freely reuse its arrays (device_put can defer-read/alias the
+            # host buffer, see InFlightRecycler)
+            buf = (recycler.pool.acquire(dt, cap) if pooled
+                   else np.zeros(cap, dtype=dt))
+            buf[:n] = src
+            dev_fields[name] = jax.device_put(buf)
+            staged.append(buf)
+        if pooled:
+            recycler.track(dev_fields.values(), staged)
+        ts2 = np.zeros(cap, dtype=np.int64)
+        ts2[:n] = ts
+        return BatchTPU(dev_fields, ts2, n, schema, wm, keys)
 
     # -- exit to host ------------------------------------------------------
     def to_rows(self) -> List[Tuple[Any, int]]:

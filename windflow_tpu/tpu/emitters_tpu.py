@@ -40,16 +40,32 @@ class TPUStageEmitter(BasicEmitter):
                  schema: Optional[TupleSchema],
                  key_extractor: Optional[Callable],
                  routing: str = "forward",
-                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT) -> None:
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 key_field: Optional[str] = None) -> None:
         super().__init__(num_dests, output_batch_size, execution_mode)
         self.schema = schema
         self.key_extractor = key_extractor
+        self.key_field = key_field  # string extractor: vectorized keys
         self.routing = routing
         n_bufs = num_dests if routing == "keyby" else 1
         self._rows: List[list] = [[] for _ in range(n_bufs)]
         self._keys: List[list] = [[] for _ in range(n_bufs)]
         self._wms: List[int] = [0] * n_bufs
         self._rr = 0
+        # staging-buffer recycling over async H2D (reference
+        # recycling_gpu.hpp per-emitter pools + in-transit counters)
+        from ..recycling import ArrayPool, InFlightRecycler
+        self.recycler = InFlightRecycler(ArrayPool())
+        self._pool_seen = (0, 0)  # (hits, misses) already added to stats
+
+    def _update_pool_stats(self) -> None:
+        """Accumulate pool counter DELTAS: several emitters may share one
+        StatsRecord (split branches), so assignment would drop data."""
+        p = self.recycler.pool
+        h0, m0 = self._pool_seen
+        self.stats.staging_pool_hits += p.hits - h0
+        self.stats.staging_pool_misses += p.misses - m0
+        self._pool_seen = (p.hits, p.misses)
 
     def emit(self, payload: Any, ts: int, wm: int,
              msg_id: Optional[int] = None) -> None:
@@ -76,10 +92,12 @@ class TPUStageEmitter(BasicEmitter):
         batch = BatchTPU.stage(rows, self.schema, self._wms[buf], keys,
                                bucket_capacity(self.output_batch_size
                                                if len(rows) <= self.output_batch_size
-                                               else len(rows)))
+                                               else len(rows)),
+                               recycler=self.recycler)
         if self.stats is not None:
             self.stats.outputs_sent += len(rows)
             self.stats.device_bytes_h2d += batch.nbytes()
+            self._update_pool_stats()
         self._rows[buf] = []
         self._keys[buf] = []
         if self.routing == "keyby":
@@ -101,6 +119,64 @@ class TPUStageEmitter(BasicEmitter):
     def flush(self) -> None:
         for buf in range(len(self._rows)):
             self._ship(buf)
+
+    # -- columnar fast path (push_columns) -----------------------------
+    def emit_columns(self, cols, ts_arr, wm: int) -> None:
+        """Vectorized staging: whole numpy columns -> one BatchTPU per
+        destination with no per-tuple Python. KEYBY partitions with numpy
+        when the key is a string field; other extractors fall back to the
+        generic per-row path."""
+        import numpy as np
+
+        if self.routing == "keyby" and self.key_field is None:
+            return super().emit_columns(cols, ts_arr, wm)
+        if self.schema is None:
+            self.schema = TupleSchema(
+                {k: np.asarray(v).dtype for k, v in cols.items()})
+        self.flush()  # row-staged partials go first (ordering)
+        n = len(ts_arr)
+        if self.routing == "keyby":
+            kcol = np.asarray(cols[self.key_field])
+            if _int_keys_hashable_as_identity(kcol, n):
+                # hash(n) == n for ints in [0, 2^61-1): the vectorized
+                # modulo routes identically to the per-tuple hash of the
+                # CPU/TPU keyby emitters
+                dests = kcol.astype(np.int64) % self.num_dests
+            else:
+                dests = np.fromiter(
+                    (hash(k) % self.num_dests for k in kcol.tolist()),
+                    dtype=np.int64, count=n)
+            for d in range(self.num_dests):
+                idx = np.nonzero(dests == d)[0]
+                if idx.size == 0:
+                    continue
+                sub = {k: np.asarray(v)[idx] for k, v in cols.items()}
+                b = BatchTPU.stage_columns(
+                    sub, ts_arr[idx], self.schema, wm,
+                    kcol[idx], self.recycler)
+                self._send_device(d, b)
+        else:
+            # copy: the caller may reuse its arrays after push_columns
+            keys = (np.array(cols[self.key_field])
+                    if self.key_field is not None else None)
+            b = BatchTPU.stage_columns(cols, ts_arr, self.schema, wm, keys,
+                                       self.recycler)
+            if self.routing == "broadcast":
+                for d in range(self.num_dests):
+                    self._send_device(d, b.copy_for_dest() if d else b)
+            else:
+                self._send_device(self._rr, b)
+                self._rr = (self._rr + 1) % self.num_dests
+        self._maybe_generate_punctuation(wm)
+
+    def _send_device(self, dest: int, batch: BatchTPU) -> None:
+        batch.id = self._next_ids[dest]
+        self._next_ids[dest] += 1
+        if self.stats is not None:
+            self.stats.outputs_sent += batch.size
+            self.stats.device_bytes_h2d += batch.nbytes()
+            self._update_pool_stats()
+        self.ports[dest].send(batch)
 
 
 class TPUForwardEmitter(BasicEmitter):
@@ -129,6 +205,21 @@ class TPUBroadcastEmitter(BasicEmitter):
             self.ports[d].send(out)
 
 
+_HASH_MODULUS = (1 << 61) - 1  # CPython hash(n) == n iff 0 <= n < 2^61-1
+
+
+def _int_keys_hashable_as_identity(kcol: np.ndarray, n: int) -> bool:
+    """True when ``kcol % num_dests`` routes exactly like the per-tuple
+    ``hash(key) % num_dests`` of the CPU/TPU keyby emitters (keys must be
+    non-negative ints below the Mersenne hash modulus)."""
+    if kcol.dtype.kind == "u":
+        return n == 0 or int(kcol.max()) < _HASH_MODULUS
+    if kcol.dtype.kind == "i":
+        return n == 0 or (int(kcol.min()) >= 0
+                          and int(kcol.max()) < _HASH_MODULUS)
+    return False
+
+
 def gather_sub_batch(batch: BatchTPU, idx: np.ndarray,
                      host_keys=None) -> BatchTPU:
     """Gather ``idx`` rows of a device batch into a new (smaller) device
@@ -144,7 +235,9 @@ def gather_sub_batch(batch: BatchTPU, idx: np.ndarray,
     sub_fields = {k: v[gidx] for k, v in batch.fields.items()}
     ts2 = batch.ts_host[gather]
     if host_keys is None and batch.host_keys is not None:
-        host_keys = [batch.host_keys[j] for j in idx]
+        hk = batch.host_keys
+        host_keys = (hk[idx] if isinstance(hk, np.ndarray)
+                     else [hk[j] for j in idx])
     keys2 = host_keys
     sub = BatchTPU(sub_fields, ts2, idx.size, batch.schema, batch.wm, keys2)
     sub.stream_tag = batch.stream_tag
@@ -183,14 +276,23 @@ class TPUKeyByEmitter(BasicEmitter):
             self.ports[0].send(batch)
             return
         host_keys = self._keys_of(batch)
-        dests = np.fromiter((hash(k) % self.num_dests for k in host_keys),
-                            dtype=np.int64, count=batch.size)
+        if (isinstance(host_keys, np.ndarray)
+                and _int_keys_hashable_as_identity(host_keys[:batch.size],
+                                                   batch.size)):
+            # hash(n) == n for ints in [0, 2^61-1): vectorized routing
+            dests = host_keys[:batch.size].astype(np.int64) % self.num_dests
+        else:
+            dests = np.fromiter(
+                (hash(k) % self.num_dests for k in host_keys),
+                dtype=np.int64, count=batch.size)
         for d in range(self.num_dests):
             idx = np.nonzero(dests == d)[0]
             if idx.size == 0:
                 continue
-            sub = gather_sub_batch(batch, idx,
-                                   [host_keys[j] for j in idx])
+            sub = gather_sub_batch(
+                batch, idx,
+                host_keys[idx] if isinstance(host_keys, np.ndarray)
+                else [host_keys[j] for j in idx])
             sub.id = self._next_ids[d]
             self._next_ids[d] += 1
             if self.stats is not None:
@@ -243,24 +345,16 @@ class TPUSplittingEmitter(BasicEmitter):
         sel: List[list] = [[] for _ in range(n_branches)]
         if self.stats is not None:
             self.stats.device_bytes_d2h += batch.nbytes()
-
-        def check(b: int) -> int:
-            if not 0 <= b < n_branches:
-                from ..basic import WindFlowError
-                raise WindFlowError(
-                    f"splitting logic returned branch index {b} outside "
-                    f"[0, {n_branches})")
-            return b
-
+        from ..runtime.emitters import check_branch_index
         for i, (payload, _ts) in enumerate(batch.to_rows()):
             s = logic(payload)
             if s is None:
                 continue
             if isinstance(s, int):
-                sel[check(s)].append(i)
+                sel[check_branch_index(s, n_branches)].append(i)
             else:
                 for b in s:
-                    sel[check(b)].append(i)
+                    sel[check_branch_index(b, n_branches)].append(i)
         return [np.asarray(ix, dtype=np.int64) for ix in sel]
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
