@@ -205,3 +205,66 @@ def test_ffat_tpu_device_mode_segmentation():
         ft.FfatTPUReplica.__init__ = orig_init
     assert coll.dups == 0
     assert coll.results == expected
+
+
+def test_ffat_tpu_ring_alias_after_drain_iterations():
+    """Regression: fire-only drain programs skip the level rebuild; window
+    queries must clip to the data extent so ring slots aliasing panes
+    evicted after the last rebuild never contribute (W_cap=2 forces long
+    drain chains; 3x ring wraparound exercises aliasing)."""
+    import jax
+    import numpy as np
+    from windflow_tpu.basic import WinType
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.ffat_tpu import Ffat_Windows_TPU
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    PANE = 1000
+    N_PANES = 100  # F is 32 -> wraps 3x
+    op = Ffat_Windows_TPU(
+        lift=lambda f: {"v": f["v"]},
+        combine=lambda a, b: {"v": a["v"] + b["v"]},
+        key_extractor="key", win_len=4 * PANE, slide_len=PANE,
+        win_type=WinType.TB, num_win_per_batch=2, key_capacity=2,
+        name="alias")
+    op.build_replicas()
+    rep = op.replicas[0]
+    got = {}
+
+    class Cap:
+        def emit_device_batch(self, b):
+            keys = np.asarray(b.fields["key"])[:b.size]
+            wids = np.asarray(b.fields["wid"])[:b.size]
+            vals = np.asarray(b.fields["v"])[:b.size]
+            valid = np.asarray(b.fields["valid"])[:b.size]
+            for k, w, v, ok in zip(keys, wids, vals, valid):
+                if ok:
+                    got[(int(k), int(w))] = int(v)
+
+        def set_stats(self, s):
+            pass
+
+        def propagate_punctuation(self, wm):
+            pass
+
+    rep.emitter = Cap()
+    schema = TupleSchema({"key": np.int32, "v": np.int32})
+    # one batch per 4 panes, 2 keys, value = pane+1; watermark trails so
+    # several windows become fireable at once and W_cap=2 forces drains
+    for base in range(0, N_PANES, 4):
+        rows_k = np.repeat(np.arange(2, dtype=np.int64), 4)
+        panes = np.tile(np.arange(base, base + 4), 2)
+        ts = panes * PANE + 5
+        vals = (panes + 1).astype(np.int32)
+        cols = {"key": jax.device_put(rows_k.astype(np.int32)),
+                "v": jax.device_put(vals)}
+        b = BatchTPU(cols, ts.astype(np.int64), 8, schema,
+                     wm=max(0, (base - 1) * PANE), host_keys=rows_k)
+        b.wm = (base + 4) * PANE  # frontier passes the batch's own panes
+        rep.handle_msg(0, b)
+    rep.flush_on_termination()
+
+    for k in range(2):
+        for w in range(N_PANES - 3):
+            expect = sum(p + 1 for p in range(w, min(w + 4, N_PANES)))
+            assert got.get((k, w)) == expect, (k, w, got.get((k, w)), expect)

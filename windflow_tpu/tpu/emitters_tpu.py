@@ -119,6 +119,8 @@ class TPUStageEmitter(BasicEmitter):
     def flush(self) -> None:
         for buf in range(len(self._rows)):
             self._ship(buf)
+        # EOS/flush: return every tracked staging buffer to the pool
+        self.recycler.drain()
 
     # -- columnar fast path (push_columns) -----------------------------
     def emit_columns(self, cols, ts_arr, wm: int) -> None:
@@ -163,16 +165,22 @@ class TPUStageEmitter(BasicEmitter):
                                        self.recycler)
             if self.routing == "broadcast":
                 for d in range(self.num_dests):
-                    self._send_device(d, b.copy_for_dest() if d else b)
+                    # device arrays are shared: one H2D transfer, count once
+                    self._send_device(d, b.copy_for_dest() if d else b,
+                                      count_stats=(d == 0))
             else:
                 self._send_device(self._rr, b)
                 self._rr = (self._rr + 1) % self.num_dests
+        # punctuation cadence is per TUPLE (basic.py DEFAULT_WM_AMOUNT),
+        # not per columnar push
+        self._emit_count += max(0, n - 1)
         self._maybe_generate_punctuation(wm)
 
-    def _send_device(self, dest: int, batch: BatchTPU) -> None:
+    def _send_device(self, dest: int, batch: BatchTPU,
+                     count_stats: bool = True) -> None:
         batch.id = self._next_ids[dest]
         self._next_ids[dest] += 1
-        if self.stats is not None:
+        if self.stats is not None and count_stats:
             self.stats.outputs_sent += batch.size
             self.stats.device_bytes_h2d += batch.nbytes()
             self._update_pool_stats()
