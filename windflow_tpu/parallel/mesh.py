@@ -64,6 +64,43 @@ def make_sharded_state(mesh, n_keys: int, n_panes: int):
             jax.device_put(counts, sharding))
 
 
+def _route_to_owners(ka: int, k_local: int, C: int, keys, panes, vals):
+    """The ICI keyby shuffle shared by the sharded steps: bucket local
+    tuples by owner shard (stable sort + run positions, capacity-masked),
+    ``lax.all_to_all`` along 'key', and recover (keys, panes, vals pytree,
+    valid mask, local key index) on the owner. Runs inside shard_map."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    tmap = jax.tree_util.tree_map
+    B = keys.shape[0]
+    dest = jnp.minimum(keys // k_local, ka - 1).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)
+    dsort, ksort, psort = dest[order], keys[order], panes[order]
+    vsort = tmap(lambda a: a[order], vals)
+    # position of each tuple within its destination run
+    start_of_dest = jnp.searchsorted(dsort, jnp.arange(ka))
+    within = jnp.arange(B) - start_of_dest[dsort]
+    ok = within < C
+    flat = dsort * C + jnp.minimum(within, C - 1)
+
+    def bucketize(col, fill):
+        buf = jnp.full((ka * C,), fill, dtype=col.dtype)
+        return buf.at[flat].set(
+            jnp.where(ok, col, fill), mode="drop").reshape(ka, C)
+
+    # the ICI shuffle: block i of every chip goes to key-shard i
+    a2a = lambda b: lax.all_to_all(b, "key", 0, 0, tiled=True).reshape(-1)
+    rk = a2a(bucketize(ksort, -1))
+    rp = a2a(bucketize(psort, 0))
+    rv = tmap(lambda a: a2a(bucketize(a, np.zeros((), a.dtype)[()])), vsort)
+    valid = rk >= 0
+    shard = lax.axis_index("key")
+    local_key = jnp.where(valid, rk - shard * k_local, 0).astype(jnp.int32)
+    return rk, rp, rv, valid, local_key
+
+
 def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
                               local_batch: int):
     """Builds the jitted global step: (state, counts, keys, values, panes)
@@ -77,7 +114,7 @@ def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     ka = mesh.shape["key"]
@@ -92,38 +129,9 @@ def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
         # state/counts: (k_local, n_panes); keys/values/panes: (B,)
         # BLOCK key ownership: shard s owns global keys
         # [s*k_local, (s+1)*k_local), so returned global row k IS key k
-        B = keys.shape[0]
-        dest = jnp.minimum(keys // k_local, ka - 1).astype(jnp.int32)
-        # bucket tuples by destination shard: (ka, C) padded with mask
-        order = jnp.argsort(dest, stable=True)
-        dsort = dest[order]
-        ksort = keys[order]
-        vsort = values[order]
-        psort = panes[order]
-        # position of each tuple within its destination run
-        start_of_dest = jnp.searchsorted(dsort, jnp.arange(ka))
-        within = jnp.arange(B) - start_of_dest[dsort]
-        ok = within < C
-        bucket_k = jnp.full((ka, C), -1, dtype=keys.dtype)
-        bucket_v = jnp.zeros((ka, C), dtype=values.dtype)
-        bucket_p = jnp.zeros((ka, C), dtype=panes.dtype)
-        flat = dsort * C + jnp.minimum(within, C - 1)
-        bucket_k = bucket_k.reshape(-1).at[flat].set(
-            jnp.where(ok, ksort, -1), mode="drop").reshape(ka, C)
-        bucket_v = bucket_v.reshape(-1).at[flat].set(
-            jnp.where(ok, vsort, 0), mode="drop").reshape(ka, C)
-        bucket_p = bucket_p.reshape(-1).at[flat].set(
-            jnp.where(ok, psort, 0), mode="drop").reshape(ka, C)
-        # the ICI shuffle: block i of every chip goes to key-shard i
-        recv_k = lax.all_to_all(bucket_k, "key", 0, 0, tiled=True)
-        recv_v = lax.all_to_all(bucket_v, "key", 0, 0, tiled=True)
-        recv_p = lax.all_to_all(bucket_p, "key", 0, 0, tiled=True)
-        rk = recv_k.reshape(-1)
-        rv = recv_v.reshape(-1)
-        rp = recv_p.reshape(-1)
-        valid = rk >= 0
-        shard = lax.axis_index("key")
-        local_key = jnp.where(valid, rk - shard * k_local, 0).astype(jnp.int32)
+        rk, rp, rv, valid, local_key = _route_to_owners(
+            ka, k_local, C, keys, panes, {"v": values})
+        rv = rv["v"]
         pane_idx = jnp.where(valid, rp % n_panes, 0).astype(jnp.int32)
         flat_idx = jnp.where(valid, local_key * n_panes + pane_idx,
                              k_local * n_panes)
@@ -148,6 +156,256 @@ def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
     return jax.jit(stepped), n_keys_padded, ka * da * local_batch
 
 
+def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
+                        slide_panes: int, local_batch: int,
+                        fire_rounds: int = 2, ring_panes: int = 0):
+    """The FLAGSHIP operator sharded over the mesh: a FlatFAT forest whose
+    key axis is block-sharded along ``'key'`` (shard s owns keys
+    [s*k_local, (s+1)*k_local)), with ingestion data-parallel along
+    ``'data'``.
+
+    Multi-chip redesign of ``tpu/ffat_tpu.py`` (single-chip keeps its
+    host-metadata control plane; here the per-key control state —
+    next_fire/max_leaf — lives ON DEVICE in the shard that owns the key,
+    so firing needs no host round-trip and no cross-chip metadata):
+
+      bucket-by-owner -> ``lax.all_to_all`` along 'key' (tuple payloads
+      ride ICI; forest state never moves) -> per-shard segmented scan +
+      leaf scatter-combine -> per-shard level rebuild -> ``fire_rounds``
+      device-side fire rounds (every owned key fires its next window when
+      the frontier passed it; queries are the same <=2 log F ring walks,
+      vmapped over the shard's keys) -> per-round leaf eviction.
+
+    Returns ``(init_fn, step_fn, meta)``:
+    - ``init_fn(sample_vals) -> state`` — 5-tuple (trees, tvalid,
+      next_fire, max_leaf, fired), properly sharded; ``sample_vals`` is a
+      pytree of (1,)-arrays carrying the RAW tuple column dtypes
+      (pre-lift);
+    - ``step_fn(*state, keys, values, panes, frontier)`` (state is
+      SPLATTED) -> flat 9-tuple ``(trees, tvalid, next_fire, max_leaf,
+      fired, results, res_valid, res_wid, n_tuples)``; results have shape
+      (K_pad, fire_rounds) per lift field — window aggregates for each
+      owned key, up to ``fire_rounds`` windows per step;
+    - ``meta = (K_pad, k_local, global_batch)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ka = mesh.shape["key"]
+    da = mesh.shape["data"]
+    if da & (da - 1):
+        raise ValueError(f"sharded_ffat_forest: the 'data' axis must be a "
+                         f"power of two for the delta-merge butterfly "
+                         f"(got {da})")
+    K_pad = math.ceil(n_keys / ka) * ka
+    k_local = K_pad // ka
+    F = ring_panes or (1 << max(3, math.ceil(
+        math.log2(win_panes + max(2 * slide_panes, 16)))))
+    if F & (F - 1) or F < win_panes + fire_rounds * slide_panes:
+        raise ValueError(
+            f"sharded_ffat_forest: ring_panes must be a power of two >= "
+            f"win_panes + fire_rounds*slide_panes (got F={F}, "
+            f"win={win_panes}, rounds={fire_rounds}, slide={slide_panes})")
+    NNODES = 2 * F
+    LOGQ = NNODES.bit_length()
+    C = local_batch  # per-destination bucket capacity (masked)
+    tmap = jax.tree_util.tree_map
+
+    def comb_valid(va, a, vb, b):
+        both = va & vb
+        merged = combine(a, b)
+        out = tmap(lambda m, x, y: jnp.where(both, m, jnp.where(va, x, y)),
+                   merged, a, b)
+        return va | vb, out
+
+    def range_query(tree_row, vrow, lo, length):
+        # loop-carry scalars must carry the shard_map varying axes
+        pv = lambda a: lax.pcast(a, ("key", "data"), to="varying")
+        zero = tmap(lambda a: pv(jnp.zeros((), a.dtype)), tree_row)
+
+        def body(_, st):
+            l, r, lv, la, rv, ra = st
+            take_l = ((l & 1) == 1) & (l < r)
+            il = jnp.clip(l, 0, NNODES - 1)
+            node_l = tmap(lambda a: a[il], tree_row)
+            lv, la = comb_valid(lv, la, vrow[il] & take_l, node_l)
+            l = jnp.where(take_l, l + 1, l)
+            take_r = ((r & 1) == 1) & (l < r)
+            ir = jnp.clip(r - 1, 0, NNODES - 1)
+            node_r = tmap(lambda a: a[ir], tree_row)
+            rv, ra = comb_valid(vrow[ir] & take_r, node_r, rv, ra)
+            r = jnp.where(take_r, r - 1, r)
+            return (l >> 1, r >> 1, lv, la, rv, ra)
+
+        init = (lo + F, lo + length + F,
+                pv(jnp.zeros((), bool)), zero, pv(jnp.zeros((), bool)), zero)
+        st = lax.fori_loop(0, LOGQ, body, init)
+        return comb_valid(st[2], st[3], st[4], st[5])
+
+    def window_query(tree_row, vrow, start_phys, length):
+        len1 = jnp.minimum(length, F - start_phys)
+        v1, r1 = range_query(tree_row, vrow, start_phys, len1)
+        v2, r2 = range_query(tree_row, vrow, jnp.zeros_like(start_phys),
+                             length - len1)
+        return comb_valid(v1, r1, v2, r2)
+
+    def local_step(trees, tvalid, next_fire, max_leaf, fired,
+                   keys, raw_vals, panes, frontier):
+        # ---- route tuples to their key-owner shard (ICI all_to_all) ----
+        recv_k, recv_p, recv_v, valid, lkey = _route_to_owners(
+            ka, k_local, C, keys, panes, raw_vals)
+
+        # ---- segmented scan by (key, pane) + leaf scatter-combine ------
+        vals = lift(recv_v)
+        leaf = jnp.where(valid, recv_p % F, 0).astype(jnp.int32)
+        big = jnp.int32(k_local * F)
+        composite = jnp.where(valid, lkey * F + leaf, big)
+        order2 = jnp.argsort(composite, stable=True)
+        sc = composite[order2]
+        same_prev = jnp.concatenate([jnp.zeros((1,), bool), sc[1:] == sc[:-1]])
+        is_end = jnp.concatenate(
+            [sc[1:] != sc[:-1], jnp.ones((1,), bool)]) & (sc < big)
+        svals = tmap(lambda a: a[order2], vals)
+
+        def seg_op(a, b):
+            fa, sa = a
+            fb, same_b = b
+            merged = combine(fa, fb)
+            out = tmap(lambda m, y: jnp.where(same_b, m, y), merged, fb)
+            return out, sa & same_b
+
+        scanned, _ = lax.associative_scan(seg_op, (svals, same_prev))
+        flat_idx = (lkey[order2] * NNODES + F + leaf[order2])
+        OOB = k_local * NNODES
+        safe_idx = jnp.where(is_end, flat_idx, OOB)
+        # scatter segment tails into a DELTA forest first: the state is
+        # replicated along 'data' while each data replica received a
+        # DISJOINT tuple subset, so deltas must merge across 'data'
+        # (butterfly ppermute with the user combine — a generic-combine
+        # all_reduce; cross-replica combine order is arbitrary, the same
+        # guarantee DEFAULT mode gives multi-replica CPU ingestion)
+        dleaf = tmap(lambda sv: jnp.zeros(
+            (k_local * NNODES,), sv.dtype).at[safe_idx].set(
+            sv, mode="drop"), scanned)
+        dvalid = jnp.zeros((k_local * NNODES,), bool).at[safe_idx].set(
+            is_end, mode="drop")
+        shift = 1
+        while shift < da:
+            perm = [(i, i ^ shift) for i in range(da)]
+            p_leaf = tmap(lambda a: lax.ppermute(a, "data", perm), dleaf)
+            p_valid = lax.ppermute(dvalid, "data", perm)
+            dvalid, dleaf = comb_valid(dvalid, dleaf, p_valid, p_leaf)
+            shift <<= 1
+        # combine the merged delta into the state leaves
+        leaf_valid = tvalid.reshape(-1) & dvalid
+        merged_all = combine(tmap(lambda t: t.reshape(-1), trees), dleaf)
+        trees = tmap(lambda t, m, dl: jnp.where(
+            dvalid, jnp.where(leaf_valid, m, dl), t.reshape(-1)
+        ).reshape(t.shape), trees, merged_all, dleaf)
+        tvalid = (tvalid.reshape(-1) | dvalid).reshape(tvalid.shape)
+        # per-key max pane (control state stays on the owner shard),
+        # merged across the data replicas
+        max_leaf = max_leaf.at[lkey].max(
+            jnp.where(valid, recv_p, -1).astype(max_leaf.dtype))
+        max_leaf = lax.pmax(max_leaf, "data")
+
+        # ---- level rebuild across the shard's forest -------------------
+        lvl = F >> 1
+        while lvl >= 1:
+            lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
+            rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
+            vlc = tvalid[:, 2 * lvl:4 * lvl:2]
+            vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
+            merged = combine(lc, rc)
+            node = tmap(lambda m, a, b: jnp.where(
+                vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
+            trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
+                         trees, node)
+            tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
+            lvl >>= 1
+
+        # ---- device-side fire rounds -----------------------------------
+        pv = lambda a: lax.pcast(a, ("key", "data"), to="varying")
+        res = tmap(lambda a: pv(jnp.zeros((k_local, fire_rounds), a.dtype)),
+                   vals)
+        res_valid = pv(jnp.zeros((k_local, fire_rounds), bool))
+        res_wid = pv(jnp.zeros((k_local, fire_rounds), jnp.int32))
+
+        def round_body(r, st):
+            trees, tvalid, next_fire, max_leaf, fired, res, rvalid, rwid = st
+            eligible = ((next_fire + win_panes <= frontier)
+                        & (max_leaf >= next_fire))
+            start = next_fire
+            length = jnp.where(
+                eligible,
+                jnp.minimum(win_panes, max_leaf + 1 - start), 0
+            ).astype(jnp.int32)
+            qv, qr = jax.vmap(window_query)(
+                trees, tvalid, (start % F).astype(jnp.int32), length)
+            qv = qv & eligible
+            res = tmap(lambda acc, q: acc.at[:, r].set(
+                jnp.where(qv, q, acc[:, r])), res, qr)
+            rvalid = rvalid.at[:, r].set(qv)
+            rwid = rwid.at[:, r].set(
+                jnp.where(eligible, fired, -1).astype(jnp.int32))
+            # evict the panes sliding out of every fired key
+            ev = start[:, None] + jnp.arange(slide_panes)[None, :]
+            ev_ok = eligible[:, None] & (ev <= max_leaf[:, None])
+            rows = jnp.broadcast_to(
+                jnp.arange(k_local)[:, None], ev.shape)
+            eflat = jnp.where(ev_ok, rows * NNODES + F + ev % F,
+                              k_local * NNODES)
+            tvalid = tvalid.reshape(-1).at[eflat.reshape(-1)].set(
+                False, mode="drop").reshape(tvalid.shape)
+            next_fire = jnp.where(eligible, next_fire + slide_panes,
+                                  next_fire)
+            fired = jnp.where(eligible, fired + 1, fired)
+            return (trees, tvalid, next_fire, max_leaf, fired,
+                    res, rvalid, rwid)
+
+        (trees, tvalid, next_fire, max_leaf, fired, res, res_valid,
+         res_wid) = lax.fori_loop(
+            0, fire_rounds, round_body,
+            (trees, tvalid, next_fire, max_leaf, fired, res, res_valid,
+             res_wid))
+        n_tuples = lax.psum(jnp.sum(valid), ("key", "data"))
+        return (trees, tvalid, next_fire, max_leaf, fired,
+                res, res_valid, res_wid, n_tuples)
+
+    def init_fn(sample_vals):
+        """sample_vals: pytree of (1,) arrays with the RAW tuple column
+        dtypes (pre-lift); returns the sharded state pytree."""
+        shapes = jax.eval_shape(lift, sample_vals)
+        sh_keys = NamedSharding(mesh, P("key", None))
+        sh_key1 = NamedSharding(mesh, P("key"))
+        trees = {name: jax.device_put(jnp.zeros((K_pad, NNODES), s.dtype),
+                                      sh_keys)
+                 for name, s in shapes.items()}
+        tvalid = jax.device_put(jnp.zeros((K_pad, NNODES), bool), sh_keys)
+        next_fire = jax.device_put(jnp.zeros((K_pad,), jnp.int32), sh_key1)
+        max_leaf = jax.device_put(jnp.full((K_pad,), -1, jnp.int32), sh_key1)
+        fired = jax.device_put(jnp.zeros((K_pad,), jnp.int32), sh_key1)
+        return trees, tvalid, next_fire, max_leaf, fired
+
+    stepped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("key", None), P("key", None), P("key"), P("key"),
+                  P("key"),
+                  P(("key", "data")), P(("key", "data")), P(("key", "data")),
+                  P()),
+        out_specs=(P("key", None), P("key", None), P("key"), P("key"),
+                   P("key"),
+                   P("key", None), P("key", None), P("key", None), P()),
+        # the butterfly delta-merge makes state/results equal across the
+        # 'data' axis, but the varying-axis type system cannot infer that
+        # replication through a generic-combine reduction
+        check_vma=False,
+    )
+    return init_fn, jax.jit(stepped), (K_pad, k_local, ka * da * local_batch)
+
+
 def ring_pane_window_query(mesh, n_panes_global: int, win_panes: int,
                            slide_panes: int):
     """Sliding-window combines over a PANE-SHARDED timeline — the
@@ -166,7 +424,7 @@ def ring_pane_window_query(mesh, n_panes_global: int, win_panes: int,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_shards = mesh.shape["key"]
