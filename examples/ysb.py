@@ -6,6 +6,11 @@ join ad->campaign (static table) -> per-campaign tumbling-window counts.
 The windowed count runs on the device plane (Ffat_Windows_TPU with a
 count+latest-ts combine); switch USE_TPU off for the CPU Ffat_Windows.
 
+END-TO-END LATENCY (the YSB metric): every event carries its ingest
+wall-clock through the whole pipeline (a relative-µs int32 column on the
+device plane); the sink reports p50/p99 of (emit wall - last contributing
+event's ingest wall) per fired window, on BOTH planes.
+
 Run: JAX_PLATFORMS=cpu python examples/ysb.py [n_events]
 (or on a TPU host with the device backend available, leave JAX_PLATFORMS
 unset; YSB_CPU=1 selects the CPU window operator.)
@@ -36,6 +41,7 @@ class AdEvent:
     ad_id: int
     event_type: int  # 0=view 1=click 2=purchase
     ts: int
+    ing: int  # ingest wall clock, µs relative to run start
 
 
 @dataclass
@@ -43,6 +49,7 @@ class CampaignEvent:
     campaign: int
     one: int
     ts: int
+    ing: int
 
 
 def fill_broker(n_events: int) -> None:
@@ -58,15 +65,21 @@ def fill_broker(n_events: int) -> None:
 def main(n_events: int = 60_000) -> None:
     fill_broker(n_events)
     results = {}
+    latencies = []
 
     graph = PipeGraph("ysb", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+    wall0 = time.perf_counter()
+
+    def now_rel() -> int:
+        return int((time.perf_counter() - wall0) * 1e6)
 
     def deser(msg, shipper):
         if msg is None:
             return False
         p = msg.payload
         shipper.push_with_timestamp(
-            AdEvent(p["ad_id"], p["event_type"], p["ts"]), p["ts"])
+            AdEvent(p["ad_id"], p["event_type"], p["ts"], now_rel()),
+            p["ts"])
         shipper.set_next_watermark(p["ts"])
         return True
 
@@ -78,16 +91,16 @@ def main(n_events: int = 60_000) -> None:
         .with_output_batch_size(1024 if USE_TPU else 0).build()
     # ad -> campaign join against the static campaign table
     project = (Map_Builder(lambda e: CampaignEvent(
-                   e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts))
+                   e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts, e.ing))
                .with_parallelism(2)
                .with_output_batch_size(1024 if USE_TPU else 0).build())
 
     if USE_TPU:
         from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
         win = (Ffat_Windows_TPU_Builder(
-                   lambda f: {"count": f["one"], "last_ts": f["ts"]},
+                   lambda f: {"count": f["one"], "last_ing": f["ing"]},
                    lambda a, b: {"count": a["count"] + b["count"],
-                                 "last_ts": b["last_ts"]})
+                                 "last_ing": b["last_ing"]})
                .with_key_by("campaign")
                .with_tb_windows(WIN_US, WIN_US)
                .with_num_win_per_batch(32)
@@ -96,15 +109,19 @@ def main(n_events: int = 60_000) -> None:
         def sink(r):
             if r is not None and r["valid"]:
                 results[(r["campaign"], r["wid"])] = r["count"]
+                latencies.append(now_rel() - r["last_ing"])
     else:
         from windflow_tpu import Ffat_Windows_Builder
-        win = (Ffat_Windows_Builder(lambda e: e.one, lambda a, b: a + b)
+        # lift to (count, last_ingest): the CPU FlatFAT combines tuples
+        win = (Ffat_Windows_Builder(lambda e: (e.one, e.ing),
+                                    lambda a, b: (a[0] + b[0], b[1]))
                .with_key_by(lambda e: e.campaign)
                .with_tb_windows(WIN_US, WIN_US).build())
 
         def sink(r):
             if r is not None and r.value is not None:
-                results[(r.key, r.wid)] = r.value
+                results[(r.key, r.wid)] = r.value[0]
+                latencies.append(now_rel() - r.value[1])
 
     graph.add_source(src).add(views).add(project).add(win).add_sink(
         Sink_Builder(sink).build())
@@ -121,9 +138,14 @@ def main(n_events: int = 60_000) -> None:
             w = (i * 100) // WIN_US
             expected[(c, w)] = expected.get((c, w), 0) + 1
     ok = results == expected
+    lat = sorted(latencies)
+    p50 = lat[len(lat) // 2] / 1e3 if lat else 0.0
+    p99 = lat[max(0, int(len(lat) * 0.99) - 1)] / 1e3 if lat else 0.0
     print(f"YSB [{'TPU' if USE_TPU else 'CPU'}]: {n_events} events in "
           f"{dt:.2f}s ({n_events/dt:,.0f} ev/s), "
-          f"{len(results)} campaign-windows, model match: {ok}")
+          f"{len(results)} campaign-windows, model match: {ok}, "
+          f"e2e latency p50={p50:.1f}ms p99={p99:.1f}ms "
+          f"(source ingest -> window emit)")
     if not ok:
         sys.exit(1)
 

@@ -1,0 +1,272 @@
+"""Real-client Kafka adapters tested against injected fake client modules
+(the image has no broker or client library; the fakes implement the small
+slice of the confluent_kafka / kafka-python APIs the adapters touch, so
+the adapter code itself — config, assign/seek, poll loops, produce —
+is exercised end-to-end through PipeGraph)."""
+
+import sys
+import threading
+import types
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph, Sink_Builder,
+                          TimePolicy, WindFlowError)
+from windflow_tpu.kafka import Kafka_Sink_Builder, Kafka_Source_Builder
+
+
+# ---------------------------------------------------------------------------
+# a tiny in-memory "cluster" shared by the fake clients
+# ---------------------------------------------------------------------------
+class _Cluster:
+    def __init__(self, n_partitions=2):
+        self.n_partitions = n_partitions
+        self.topics = {}
+        self.lock = threading.Lock()
+
+    def produce(self, topic, value, partition):
+        with self.lock:
+            parts = self.topics.setdefault(
+                topic, [[] for _ in range(self.n_partitions)])
+            if partition is None:
+                partition = sum(len(p) for p in parts) % self.n_partitions
+            parts[partition].append(value)
+
+    def fetch(self, topic, partition, offset):
+        with self.lock:
+            parts = self.topics.get(topic)
+            if parts is None or offset >= len(parts[partition]):
+                return None
+            return parts[partition][offset]
+
+
+# ---------------------------------------------------------------------------
+# fake confluent_kafka
+# ---------------------------------------------------------------------------
+def make_fake_confluent(cluster):
+    class TopicPartition:
+        def __init__(self, topic, partition, offset=0):
+            self.topic, self.partition, self.offset = topic, partition, offset
+
+    class _Msg:
+        def __init__(self, topic, partition, offset, value):
+            self._t, self._p, self._o, self._v = topic, partition, offset, value
+
+        def topic(self):
+            return self._t
+
+        def partition(self):
+            return self._p
+
+        def offset(self):
+            return self._o
+
+        def value(self):
+            return self._v
+
+        def error(self):
+            return None
+
+        def timestamp(self):
+            return (1, 1234)
+
+    class Consumer:
+        def __init__(self, conf):
+            assert "bootstrap.servers" in conf and "group.id" in conf
+            self.conf = conf
+            self._pos = {}
+            self._closed = False
+
+        def subscribe(self, topics):
+            for t in topics:
+                for p in range(cluster.n_partitions):
+                    self._pos[(t, p)] = 0
+
+        def assign(self, tps):
+            for tp in tps:
+                self._pos[(tp.topic, tp.partition)] = tp.offset
+
+        def poll(self, timeout):
+            for (t, p), o in self._pos.items():
+                v = cluster.fetch(t, p, o)
+                if v is not None:
+                    self._pos[(t, p)] = o + 1
+                    return _Msg(t, p, o, v)
+            return None
+
+        def close(self):
+            self._closed = True
+
+    class Producer:
+        def __init__(self, conf):
+            assert "bootstrap.servers" in conf
+            self.flushed = False
+
+        def produce(self, topic, value=None, partition=None, key=None,
+                    on_delivery=None):
+            cluster.produce(topic, value, partition)
+            if on_delivery is not None:
+                on_delivery(None, None)  # delivered
+
+        def poll(self, timeout):
+            return 0
+
+        def flush(self, timeout=None):
+            self.flushed = True
+
+    return types.SimpleNamespace(Consumer=Consumer, Producer=Producer,
+                                 TopicPartition=TopicPartition)
+
+
+# ---------------------------------------------------------------------------
+# fake kafka-python
+# ---------------------------------------------------------------------------
+def make_fake_kafka_python(cluster):
+    class TopicPartition:
+        def __init__(self, topic, partition):
+            self.topic, self.partition = topic, partition
+
+        def __hash__(self):
+            return hash((self.topic, self.partition))
+
+        def __eq__(self, o):
+            return (self.topic, self.partition) == (o.topic, o.partition)
+
+    class _Rec:
+        def __init__(self, topic, partition, offset, value):
+            self.topic, self.partition = topic, partition
+            self.offset, self.value = offset, value
+            self.timestamp = 1234
+
+    class KafkaConsumer:
+        def __init__(self, bootstrap_servers=None, group_id=None,
+                     enable_auto_commit=True, auto_offset_reset="latest"):
+            assert bootstrap_servers
+            self._pos = {}
+
+        def subscribe(self, topics):
+            for t in topics:
+                for p in range(cluster.n_partitions):
+                    self._pos[(t, p)] = 0
+
+        def assign(self, tps):
+            for tp in tps:
+                self._pos.setdefault((tp.topic, tp.partition), 0)
+
+        def seek(self, tp, offset):
+            self._pos[(tp.topic, tp.partition)] = offset
+
+        def poll(self, timeout_ms=0, max_records=None):
+            for (t, p), o in self._pos.items():
+                v = cluster.fetch(t, p, o)
+                if v is not None:
+                    self._pos[(t, p)] = o + 1
+                    return {TopicPartition(t, p): [_Rec(t, p, o, v)]}
+            return {}
+
+        def close(self):
+            pass
+
+    class KafkaProducer:
+        def __init__(self, bootstrap_servers=None):
+            assert bootstrap_servers
+
+        def send(self, topic, value=None, partition=None, key=None):
+            cluster.produce(topic, value, partition)
+
+        def flush(self, timeout=None):
+            pass
+
+    return types.SimpleNamespace(KafkaConsumer=KafkaConsumer,
+                                 KafkaProducer=KafkaProducer,
+                                 TopicPartition=TopicPartition)
+
+
+def _run_roundtrip():
+    """Kafka_Source('in') -> Map -> Kafka_Sink('out') against a real-looking
+    broker string; returns the cluster's 'out' topic contents."""
+    from windflow_tpu.kafka.connectors import make_transport
+
+    # seed the input topic through the adapter's own produce path
+    t = make_transport("localhost:9092")
+    for i in range(20):
+        t.produce("in", i, partition=i % 2)
+    t.flush()
+
+    seen = []
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False  # idle -> stop
+        shipper.push({"v": msg.payload})
+        return True
+
+    def ser(t):
+        return ("out", None, t["v"] * 10)
+
+    graph = PipeGraph("kafka_real", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+    src = (Kafka_Source_Builder(deser).with_brokers("localhost:9092")
+           .with_topics("in").with_group_id("g1")
+           .with_idleness(50).build())
+    sink = Kafka_Sink_Builder(ser).with_brokers("localhost:9092").build()
+    graph.add_source(src).add(
+        Map_Builder(lambda t: {"v": t["v"]}).build()).add_sink(sink)
+    graph.run()
+
+
+def test_confluent_adapter_roundtrip(monkeypatch):
+    cluster = _Cluster()
+    monkeypatch.setitem(sys.modules, "confluent_kafka",
+                        make_fake_confluent(cluster))
+    monkeypatch.delitem(sys.modules, "kafka", raising=False)
+    _run_roundtrip()
+    got = sorted(v for part in cluster.topics["out"] for v in part)
+    assert got == [i * 10 for i in range(20)]
+
+
+def test_kafka_python_adapter_roundtrip(monkeypatch):
+    cluster = _Cluster()
+    fake = make_fake_kafka_python(cluster)
+    monkeypatch.setitem(sys.modules, "kafka", fake)
+    # ensure confluent is absent so the kafka-python path is chosen
+    monkeypatch.setitem(sys.modules, "confluent_kafka", None)
+    _run_roundtrip()
+    got = sorted(v for part in cluster.topics["out"] for v in part)
+    assert got == [i * 10 for i in range(20)]
+
+
+def test_kafka_python_explicit_offsets(monkeypatch):
+    """Offsets map -> assign+seek path of the kafka-python adapter."""
+    cluster = _Cluster()
+    monkeypatch.setitem(sys.modules, "kafka",
+                        make_fake_kafka_python(cluster))
+    monkeypatch.setitem(sys.modules, "confluent_kafka", None)
+    from windflow_tpu.kafka.connectors import make_transport
+
+    t = make_transport("localhost:9092")
+    for i in range(10):
+        t.produce("t0", i, partition=0)
+    got = []
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        got.append(msg.payload)
+        return True
+
+    graph = PipeGraph("kafka_offsets")
+    src = (Kafka_Source_Builder(deser).with_brokers("localhost:9092")
+           .with_topics("t0").with_offsets({("t0", 0): 6})
+           .with_idleness(50).build())
+    graph.add_source(src).add_sink(Sink_Builder(lambda x: None).build())
+    graph.run()
+    assert got == [6, 7, 8, 9]
+
+
+def test_no_client_fails_fast_at_construction(monkeypatch):
+    monkeypatch.setitem(sys.modules, "confluent_kafka", None)
+    monkeypatch.setitem(sys.modules, "kafka", None)
+    with pytest.raises(WindFlowError, match="client"):
+        (Kafka_Source_Builder(lambda m, s: False)
+         .with_brokers("localhost:9092").with_topics("x").build())

@@ -5,16 +5,17 @@ poll loop with idle timeout, a user deserialization functor returning a
 continue flag, explicit start offsets) and ``wf/kafka/kafka_sink.hpp:71-379``
 (user serializer returning (topic, partition, payload)).
 
-The reference links librdkafka; this image has no Kafka client library, so
-the transport is pluggable:
+The reference links librdkafka; here the transport is pluggable behind one
+small interface (subscribe/consume/produce/flush/close):
 
 - broker string ``"memory://<name>"`` uses the built-in in-process
-  ``MemoryBroker`` (partitioned topics, offsets, consumer groups) — this is
-  what the tests run against and it exercises the full replay/offset
-  surface;
-- any other broker string requires ``confluent_kafka`` or ``kafka-python``
-  at runtime; absence raises a clear error at build() (capability gated,
-  not stubbed silently).
+  ``MemoryBroker`` (partitioned topics, offsets, consumer groups) — it
+  exercises the full replay/offset surface without a server;
+- any other broker string goes through ``ConfluentTransport``
+  (confluent_kafka / librdkafka, preferred) or ``KafkaPythonTransport``
+  (kafka-python). A missing client library fails fast at operator
+  CONSTRUCTION with a clear error, never silently at runtime; the
+  adapters are unit-tested against injected fake client modules.
 """
 
 from __future__ import annotations
@@ -130,6 +131,211 @@ def _require_kafka_client():
 
 
 # ---------------------------------------------------------------------------
+# Transports: the replica loops speak this small interface; memory:// is
+# the in-process test transport, real brokers go through confluent_kafka
+# or kafka-python (the reference links librdkafka directly,
+# ``kafka_source.hpp:127-519`` / ``kafka_sink.hpp:71-379``)
+# ---------------------------------------------------------------------------
+class MemoryTransport:
+    def __init__(self, name: str) -> None:
+        self.broker = MemoryBroker.get(name)
+        self._parts: List[Tuple[str, int]] = []
+        self._pos: Dict[Tuple[str, int], int] = {}
+        self._rr = 0
+
+    def subscribe(self, topics, group, member, n_members, offsets) -> bool:
+        for t in topics:
+            for p in self.broker.assign_partitions(t, group, member,
+                                                   n_members):
+                self._parts.append((t, p))
+                self._pos[(t, p)] = offsets.get((t, p), 0)
+        return bool(self._parts)
+
+    def consume(self) -> Optional[KafkaMessage]:
+        for _ in range(len(self._parts)):
+            tp = self._parts[self._rr]
+            self._rr = (self._rr + 1) % len(self._parts)
+            msg = self.broker.poll(tp[0], tp[1], self._pos[tp])
+            if msg is not None:
+                self._pos[tp] += 1
+                return msg
+        return None
+
+    def produce(self, topic, payload, partition=None, key=None) -> None:
+        self.broker.produce(topic, payload, partition, key)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _member_share(offsets, member: int, n_members: int):
+    """Deterministic split of explicitly-assigned partitions across the
+    replica group (partition p -> member p % n_members — the same rule
+    MemoryBroker.assign_partitions uses, so memory:// and real brokers
+    behave identically under parallelism)."""
+    return {(t, p): o for (t, p), o in offsets.items()
+            if p % n_members == member}
+
+
+class ConfluentTransport:
+    """confluent_kafka (librdkafka) adapter. ``module`` is injectable for
+    tests (a fake with Consumer/Producer/TopicPartition)."""
+
+    def __init__(self, brokers: str, module=None) -> None:
+        if module is None:
+            import confluent_kafka as module  # noqa: PLC0415
+        self._ck = module
+        self.brokers = brokers
+        self._consumer = None
+        self._producer = None
+        self._delivery_errors = 0
+
+    def subscribe(self, topics, group, member, n_members, offsets) -> bool:
+        ck = self._ck
+        self._consumer = ck.Consumer({
+            "bootstrap.servers": self.brokers,
+            "group.id": group,
+            "enable.auto.commit": True,
+            "auto.offset.reset": "earliest",
+        })
+        if offsets:
+            # explicit offsets = explicit assignment (reference
+            # kafka_source.hpp manual-offset mode): the listed partitions
+            # are split across the replica group deterministically so
+            # parallel replicas never double-consume
+            mine = _member_share(offsets, member, n_members)
+            if not mine:
+                return False
+            self._consumer.assign([ck.TopicPartition(t, p, o)
+                                   for (t, p), o in mine.items()])
+        else:
+            self._consumer.subscribe(list(topics))
+        return True
+
+    def consume(self) -> Optional[KafkaMessage]:
+        msg = self._consumer.poll(0.01)
+        if msg is None:
+            return None
+        err = msg.error()
+        if err is not None:
+            if getattr(err, "fatal", lambda: False)():
+                raise WindFlowError(f"Kafka consumer error: {err}")
+            return None  # transient (e.g. partition EOF)
+        ts = msg.timestamp()
+        ts_us = ts[1] * 1000 if ts and ts[1] > 0 else current_time_usecs()
+        return KafkaMessage(msg.topic(), msg.partition(), msg.offset(),
+                            msg.value(), ts_us)
+
+    def _ensure_producer(self):
+        if self._producer is None:
+            self._producer = self._ck.Producer(
+                {"bootstrap.servers": self.brokers})
+            self._delivery_errors = 0
+
+        return self._producer
+
+    def _on_delivery(self, err, msg) -> None:
+        if err is not None:
+            self._delivery_errors += 1
+
+    def produce(self, topic, payload, partition=None, key=None) -> None:
+        kwargs = {"on_delivery": self._on_delivery}
+        if partition is not None:
+            kwargs["partition"] = partition
+        if key is not None:
+            kwargs["key"] = key
+        p = self._ensure_producer()
+        p.produce(topic, value=payload, **kwargs)
+        p.poll(0)  # serve delivery callbacks
+
+    def flush(self) -> None:
+        if self._producer is None:
+            return
+        remaining = self._producer.flush(10)
+        if remaining or self._delivery_errors:
+            raise WindFlowError(
+                f"Kafka sink lost data: {self._delivery_errors} delivery "
+                f"error(s), {remaining or 0} message(s) still queued at "
+                "flush timeout")
+
+    def close(self) -> None:
+        if self._consumer is not None:
+            self._consumer.close()
+
+
+class KafkaPythonTransport:
+    """kafka-python adapter (pure-python client). ``module`` injectable."""
+
+    def __init__(self, brokers: str, module=None) -> None:
+        if module is None:
+            import kafka as module  # noqa: PLC0415
+        self._kp = module
+        self.brokers = brokers.split(",")
+        self._consumer = None
+        self._producer = None
+
+    def subscribe(self, topics, group, member, n_members, offsets) -> bool:
+        kp = self._kp
+        self._consumer = kp.KafkaConsumer(
+            bootstrap_servers=self.brokers, group_id=group,
+            enable_auto_commit=True, auto_offset_reset="earliest")
+        if offsets:
+            mine = _member_share(offsets, member, n_members)
+            if not mine:
+                return False
+            tps = [kp.TopicPartition(t, p) for (t, p) in mine]
+            self._consumer.assign(tps)
+            for (t, p), o in mine.items():
+                self._consumer.seek(kp.TopicPartition(t, p), o)
+        else:
+            self._consumer.subscribe(list(topics))
+        return True
+
+    def consume(self) -> Optional[KafkaMessage]:
+        polled = self._consumer.poll(timeout_ms=10, max_records=1)
+        for _tp, records in polled.items():
+            for r in records:
+                ts_us = (r.timestamp * 1000 if getattr(r, "timestamp", 0)
+                         else current_time_usecs())
+                return KafkaMessage(r.topic, r.partition, r.offset,
+                                    r.value, ts_us)
+        return None
+
+    def _ensure_producer(self):
+        if self._producer is None:
+            self._producer = self._kp.KafkaProducer(
+                bootstrap_servers=self.brokers)
+        return self._producer
+
+    def produce(self, topic, payload, partition=None, key=None) -> None:
+        self._ensure_producer().send(topic, value=payload,
+                                     partition=partition, key=key)
+
+    def flush(self) -> None:
+        if self._producer is not None:
+            self._producer.flush(timeout=10)
+
+    def close(self) -> None:
+        if self._consumer is not None:
+            self._consumer.close()
+
+
+def make_transport(brokers: str):
+    """memory:// -> MemoryTransport; anything else -> the first available
+    real client (confluent_kafka preferred, then kafka-python)."""
+    kind, target = _parse_brokers(brokers)
+    if kind == "memory":
+        return MemoryTransport(target)
+    client = _require_kafka_client()
+    if client == "confluent":
+        return ConfluentTransport(target)
+    return KafkaPythonTransport(target)
+
+
+# ---------------------------------------------------------------------------
 # Kafka_Source
 # ---------------------------------------------------------------------------
 class Kafka_Source(BasicOperator):
@@ -169,47 +375,37 @@ class KafkaSourceReplica(BasicReplica):
 
     def run_source(self) -> None:
         op = self.op
-        kind, target = _parse_brokers(op.brokers)
-        if kind != "memory":
-            raise WindFlowError("real Kafka transport not wired in this "
-                                "environment; use memory://")
-        broker = MemoryBroker.get(target)
+        transport = make_transport(op.brokers)
+        try:
+            if not transport.subscribe(op.topics, op.group_id, self.idx,
+                                       op.parallelism, op.offsets):
+                return
+            self._consume_loop(transport)
+        finally:
+            transport.close()
+
+    def _consume_loop(self, transport) -> None:
+        op = self.op
         shipper = SourceShipper(self)
-        positions: Dict[Tuple[str, int], int] = {}
-        my_parts: List[Tuple[str, int]] = []
-        for topic in op.topics:
-            for p in broker.assign_partitions(topic, op.group_id, self.idx,
-                                              op.parallelism):
-                my_parts.append((topic, p))
-                positions[(topic, p)] = op.offsets.get((topic, p), 0)
-        if not my_parts:
-            return
         idle_budget_us = op.idleness_ms * 1000
         last_progress = current_time_usecs()
-        running = True
-        while running:
-            progressed = False
-            for tp in my_parts:
-                msg = broker.poll(tp[0], tp[1], positions[tp])
-                if msg is None:
-                    continue
-                positions[tp] += 1
-                progressed = True
+        while True:
+            msg = transport.consume()
+            if msg is not None:
                 last_progress = current_time_usecs()
                 cont = (op.deser_func(msg, shipper, self.context)
                         if op._riched else op.deser_func(msg, shipper))
                 if cont is False:
-                    running = False
-                    break
-            if not progressed:
-                if current_time_usecs() - last_progress > idle_budget_us:
-                    # idle timeout: give the functor a chance to stop
-                    cont = (op.deser_func(None, shipper, self.context)
-                            if op._riched else op.deser_func(None, shipper))
-                    if cont is False:
-                        break
-                    last_progress = current_time_usecs()
-                time.sleep(0.001)
+                    return
+                continue
+            if current_time_usecs() - last_progress > idle_budget_us:
+                # idle timeout: give the functor a chance to stop
+                cont = (op.deser_func(None, shipper, self.context)
+                        if op._riched else op.deser_func(None, shipper))
+                if cont is False:
+                    return
+                last_progress = current_time_usecs()
+            time.sleep(0.001)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         if wm > self.cur_wm:
@@ -246,11 +442,7 @@ class Kafka_Sink(BasicOperator):
 class KafkaSinkReplica(BasicReplica):
     def __init__(self, op, idx):
         super().__init__(op, idx)
-        kind, target = _parse_brokers(op.brokers)
-        if kind != "memory":
-            raise WindFlowError("real Kafka transport not wired in this "
-                                "environment; use memory://")
-        self._broker = MemoryBroker.get(target)
+        self._transport = make_transport(op.brokers)
 
     def process(self, payload, ts, wm, tag):
         out = (self.op.ser_func(payload, self.context) if self.op._riched
@@ -258,4 +450,8 @@ class KafkaSinkReplica(BasicReplica):
         if out is None:
             return
         topic, partition, data = out
-        self._broker.produce(topic, data, partition)
+        self._transport.produce(topic, data, partition)
+
+    def flush_on_termination(self) -> None:
+        self._transport.flush()
+        self._transport.close()

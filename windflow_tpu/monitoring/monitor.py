@@ -48,7 +48,8 @@ class MonitoringThread(threading.Thread):
         try:
             f = sock.makefile("w")
             f.write(json.dumps({"type": "diagram", "graph": self.graph.name,
-                                "dot": self.graph.to_dot()}) + "\n")
+                                "dot": self.graph.to_dot(),
+                                "svg": self.graph.to_svg()}) + "\n")
             f.flush()
             while not self._stop_evt.wait(self.period):
                 f.write(json.dumps({"type": "report",
@@ -68,6 +69,25 @@ class MonitoringThread(threading.Thread):
                 pass
 
 
+def _safe_diagram(svg, dot: str) -> str:
+    """Diagram data arrives over an unauthenticated TCP port, so it is
+    untrusted: embed the SVG only when it carries no active content
+    (inline SVG may legally contain <script>/event handlers), otherwise
+    fall back to the HTML-escaped dot source."""
+    import html as _html
+    import re
+
+    if svg:
+        low = svg.lower()
+        if (low.lstrip().startswith("<svg")
+                and "<script" not in low
+                and "javascript:" not in low
+                and "<foreignobject" not in low
+                and not re.search(r"\son\w+\s*=", low)):
+            return svg
+    return f"<pre>{_html.escape(dot)}</pre>"
+
+
 class MonitoringServer:
     """Accepts monitoring connections; keeps the latest diagram/report per
     graph (the dashboard-server analog, ``dashboard/Server`` in the
@@ -80,6 +100,7 @@ class MonitoringServer:
         self._srv.listen(16)
         self.host, self.port = self._srv.getsockname()
         self.diagrams: Dict[str, str] = {}
+        self.svgs: Dict[str, str] = {}  # rendered dataflow SVG per graph
         self.reports: Dict[str, Any] = {}
         self.n_reports = 0
         self._lock = threading.Lock()
@@ -110,6 +131,8 @@ class MonitoringServer:
                 with self._lock:
                     if msg.get("type") == "diagram":
                         self.diagrams[msg["graph"]] = msg["dot"]
+                        if msg.get("svg"):
+                            self.svgs[msg["graph"]] = msg["svg"]
                     elif msg.get("type") == "report":
                         self.reports[msg["graph"]] = msg["stats"]
                         self.n_reports += 1
@@ -124,6 +147,7 @@ class MonitoringServer:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {"diagrams": dict(self.diagrams),
+                    "svgs": dict(self.svgs),
                     "reports": dict(self.reports),
                     "n_reports": self.n_reports}
 
@@ -194,9 +218,10 @@ class MonitoringServer:
                             f"<th>tuples/s</th><th>svc µs</th>"
                             f"<th>device progs</th></tr>"
                             + "".join(ops) + "</table>"
-                            f"<details><summary>dataflow graph</summary>"
-                            f"<pre>{snap['diagrams'].get(g, '')}</pre>"
-                            f"</details>")
+                            f"<details open><summary>dataflow graph</summary>"
+                            + _safe_diagram(snap["svgs"].get(g),
+                                            snap["diagrams"].get(g, ""))
+                            + "</details>")
                     self._send(200,
                                "<html><head><meta http-equiv='refresh' "
                                "content='2'><title>windflow_tpu</title>"

@@ -363,20 +363,39 @@ class PipeGraph:
         }
 
     def dump_stats(self, log_dir: str = "log") -> str:
-        """JSON stats + the dataflow diagram (the reference renders a PDF at
-        wait_end, ``wf/pipegraph.hpp:732-734``; we write the dot source —
-        render with ``dot -Tpdf`` where graphviz is installed)."""
+        """JSON stats + the dataflow diagram. The reference renders a PDF
+        at wait_end and an SVG for the dashboard
+        (``wf/pipegraph.hpp:525-534,732-734``); here the dot source and an
+        SVG are always written (built-in layered renderer when no ``dot``
+        binary exists) and a PDF additionally when Graphviz is present."""
+        from ..monitoring.diagram import render_graphviz
+
         os.makedirs(log_dir, exist_ok=True)
         safe = "".join(c if c.isalnum() or c in "-_." else "_"
                        for c in self.name) or "pipegraph"
         path = os.path.join(log_dir, f"{safe}_stats.json")
         with open(path, "w") as f:
             json.dump(self.get_stats(), f, indent=2)
+        dot_src = self.to_dot()
         with open(os.path.join(log_dir, f"{safe}_diagram.dot"), "w") as f:
-            f.write(self.to_dot() + "\n")
+            f.write(dot_src + "\n")
+        svg = render_graphviz(dot_src, "svg")
+        with open(os.path.join(log_dir, f"{safe}_diagram.svg"), "wb") as f:
+            f.write(svg if svg is not None else self.to_svg().encode())
+        pdf = render_graphviz(dot_src, "pdf")
+        if pdf is not None:
+            with open(os.path.join(log_dir, f"{safe}_diagram.pdf"),
+                      "wb") as f:
+                f.write(pdf)
         return path
 
     # -- diagram (reference builds a Graphviz PDF/SVG) ---------------------
+    def to_svg(self) -> str:
+        """Dependency-free layered SVG of the stage DAG (the dashboard
+        diagram; Graphviz output is preferred when a binary exists)."""
+        from ..monitoring.diagram import stages_to_svg
+        return stages_to_svg(self._stages, self.name)
+
     def to_dot(self) -> str:
         gname = self.name.replace('"', "'")
         lines = [f'digraph "{gname}" {{', "  rankdir=LR;",
