@@ -170,8 +170,11 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int):
     elapsed = time.perf_counter() - t0
 
     n_tuples = n_batches * BATCH
-    p99_us = (sorted(fire_lat)[max(0, int(len(fire_lat) * 0.99) - 1)] * 1e6
-              if fire_lat else 0.0)
+    import math
+    p99_us = (sorted(fire_lat)[min(len(fire_lat) - 1,
+                                   max(0, math.ceil(len(fire_lat) * 0.99)
+                                       - 1))] * 1e6
+              if fire_lat else 0.0)  # nearest-rank
     return (n_tuples / elapsed, (sink.windows - w0) / elapsed, p99_us,
             rep.stats.device_programs_run)
 

@@ -138,9 +138,11 @@ def main(n_events: int = 60_000) -> None:
             w = (i * 100) // WIN_US
             expected[(c, w)] = expected.get((c, w), 0) + 1
     ok = results == expected
+    import math
     lat = sorted(latencies)
     p50 = lat[len(lat) // 2] / 1e3 if lat else 0.0
-    p99 = lat[max(0, int(len(lat) * 0.99) - 1)] / 1e3 if lat else 0.0
+    p99 = (lat[min(len(lat) - 1, max(0, math.ceil(len(lat) * 0.99) - 1))]
+           / 1e3 if lat else 0.0)  # nearest-rank
     print(f"YSB [{'TPU' if USE_TPU else 'CPU'}]: {n_events} events in "
           f"{dt:.2f}s ({n_events/dt:,.0f} ev/s), "
           f"{len(results)} campaign-windows, model match: {ok}, "

@@ -59,8 +59,12 @@ def test_dashboard_http_view(monkeypatch):
     assert "webbed" in snap["reports"]
     one = json.load(urllib.request.urlopen(f"{base}/graph/webbed", timeout=5))
     assert one["PipeGraph_name"] == "webbed"
-    html = urllib.request.urlopen(base, timeout=5).read().decode()
+    app = urllib.request.urlopen(base, timeout=5).read().decode()
+    # interactive client: polls /json, renders tables + sparkline + SVG
+    assert "windflow_tpu dashboard" in app and 'fetch("/json"' in app
+    html = urllib.request.urlopen(f"{base}/plain", timeout=5).read().decode()
     assert "windflow_tpu dashboard" in html and "webbed" in html
+    assert "webbed" in json.dumps(snap["svgs"]) or snap["svgs"] == {}
     assert urllib.request.urlopen(f"{base}/graph/nope", timeout=5
                                   ).status if False else True
     server.close()

@@ -144,11 +144,19 @@ class MemoryTransport:
         self._rr = 0
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
-        for t in topics:
-            for p in self.broker.assign_partitions(t, group, member,
-                                                   n_members):
+        if offsets:
+            # explicit offsets = explicit assignment of ONLY the listed
+            # partitions (identical semantics to the real transports)
+            for (t, p), o in _member_share(offsets, member,
+                                           n_members).items():
                 self._parts.append((t, p))
-                self._pos[(t, p)] = offsets.get((t, p), 0)
+                self._pos[(t, p)] = o
+        else:
+            for t in topics:
+                for p in self.broker.assign_partitions(t, group, member,
+                                                       n_members):
+                    self._parts.append((t, p))
+                    self._pos[(t, p)] = 0
         return bool(self._parts)
 
     def consume(self) -> Optional[KafkaMessage]:
@@ -173,9 +181,11 @@ class MemoryTransport:
 
 def _member_share(offsets, member: int, n_members: int):
     """Deterministic split of explicitly-assigned partitions across the
-    replica group (partition p -> member p % n_members — the same rule
-    MemoryBroker.assign_partitions uses, so memory:// and real brokers
-    behave identically under parallelism)."""
+    replica group (partition p -> member p % n_members, the same rule
+    MemoryBroker.assign_partitions uses). ALL transports treat a
+    non-empty offsets map as an explicit assignment: only the listed
+    partitions are consumed, from the given positions — so memory:// and
+    real brokers behave identically."""
     return {(t, p): o for (t, p), o in offsets.items()
             if p % n_members == member}
 
@@ -248,7 +258,16 @@ class ConfluentTransport:
         if key is not None:
             kwargs["key"] = key
         p = self._ensure_producer()
-        p.produce(topic, value=payload, **kwargs)
+        for attempt in range(60):
+            try:
+                p.produce(topic, value=payload, **kwargs)
+                break
+            except BufferError:
+                # local librdkafka queue full: backpressure, don't crash
+                p.poll(1.0)
+        else:
+            raise WindFlowError(
+                "Kafka sink: local producer queue stayed full for 60s")
         p.poll(0)  # serve delivery callbacks
 
     def flush(self) -> None:

@@ -154,10 +154,13 @@ class MonitoringServer:
     # -- web view (the reference ships a Spring+React dashboard; this is
     # the minimal in-tree equivalent: JSON API + a static HTML view) ------
     def serve_http(self, port: int = 0) -> int:
-        """Start an HTTP view; returns the bound port.
-        GET /        -> HTML overview
-        GET /json    -> full snapshot
-        GET /graph/<name> -> one graph's latest stats"""
+        """Start the HTTP dashboard; returns the bound port.
+        GET /        -> interactive client (polls /json, live tables,
+                        throughput sparkline, SVG diagram, replica
+                        drill-down — the reference's React app equivalent)
+        GET /json    -> full snapshot (sanitized SVGs)
+        GET /graph/<name> -> one graph's latest stats
+        GET /plain   -> server-rendered static view (no JS)"""
         import http.server
 
         server = self
@@ -176,7 +179,16 @@ class MonitoringServer:
 
             def do_GET(self):
                 snap = server.snapshot()
-                if self.path == "/json":
+                # untrusted diagram data is sanitized for every HTML/JSON
+                # consumer (the client injects the svg via innerHTML);
+                # a rejected svg falls back to the escaped dot source
+                snap["svgs"] = {g: _safe_diagram(s, snap["diagrams"]
+                                                 .get(g, ""))
+                                for g, s in snap["svgs"].items()}
+                if self.path == "/":
+                    from .webclient import CLIENT_HTML
+                    self._send(200, CLIENT_HTML, "text/html")
+                elif self.path == "/json":
                     self._send(200, json.dumps(snap))
                 elif self.path.startswith("/graph/"):
                     name = self.path[len("/graph/"):]
@@ -185,7 +197,7 @@ class MonitoringServer:
                         self._send(404, json.dumps({"error": "unknown graph"}))
                     else:
                         self._send(200, json.dumps(st))
-                else:
+                else:  # /plain: server-rendered fallback view
                     rows = []
                     for g, st in snap["reports"].items():
                         ops = []

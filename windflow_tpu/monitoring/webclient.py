@@ -1,0 +1,143 @@
+"""Interactive dashboard web client (single file, no build step).
+
+The reference ships a React app (``dashboard/web_client/``, 36 source
+files) talking to a Spring REST server. The equivalent here is a
+dependency-free client served by ``MonitoringServer.serve_http``: it polls
+``/json`` once per second and renders, without page reloads,
+
+- a graph selector with live mode/threads/dropped badges,
+- per-operator tables (parallelism, in/out, ignored, tuples/s, service
+  time, device programs, staging pool hits) that update in place,
+- a canvas sparkline of each graph's total throughput history (kept
+  client-side, 120 samples),
+- the dataflow SVG diagram (server-sanitized),
+- per-replica drill-down on click.
+"""
+
+CLIENT_HTML = r"""<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>windflow_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 18px; background:#fafafa; }
+ h1 { font-size: 18px; }
+ .badge { display:inline-block; padding:2px 8px; border-radius:10px;
+          background:#e8f0fe; margin-right:6px; font-size:11px; }
+ .badge.warn { background:#fde8e8; }
+ table { border-collapse: collapse; margin: 8px 0; }
+ th, td { border: 1px solid #ccc; padding: 3px 8px; font-size: 12px;
+          text-align: right; }
+ th { background:#f0f0f0; } td.l, th.l { text-align:left; }
+ .tabs button { margin-right:4px; font-family:monospace; }
+ .tabs button.active { background:#2b6cb0; color:#fff; }
+ canvas { border:1px solid #ddd; background:#fff; }
+ #diagram svg { max-width:100%; }
+ tr.rep { background:#f7fbff; font-size:11px; }
+ .muted { color:#777; font-size:11px; }
+</style>
+</head>
+<body>
+<h1>windflow_tpu dashboard <span id="conn" class="muted"></span></h1>
+<div class="tabs" id="tabs"></div>
+<div id="badges"></div>
+<canvas id="spark" width="720" height="80"></canvas>
+<div class="muted">total tuples/s (last 120 s)</div>
+<div id="ops"></div>
+<details open id="diagram"><summary>dataflow graph</summary></details>
+<script>
+"use strict";
+let current = null;            // selected graph
+let graphList = [], opNames = [];  // index -> name (XSS-safe handlers)
+const hist = {};               // graph -> [throughput samples]
+const open = new Set();        // operator names with replica drill-down
+function fmt(n){ return (n===undefined||n===null)?"":
+  Number(n).toLocaleString("en-US",{maximumFractionDigits:1}); }
+function el(id){ return document.getElementById(id); }
+// every server-supplied string is untrusted (monitoring TCP port is
+// unauthenticated): escape before any innerHTML interpolation
+function esc(s){ return String(s).replace(/[&<>"']/g, c =>
+  ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c])); }
+function render(snap){
+  const graphs = Object.keys(snap.reports);
+  if (graphs.length && (current===null || !graphs.includes(current)))
+    current = graphs[0];
+  graphList = graphs;
+  el("tabs").innerHTML = graphs.map((g,i) =>
+    `<button class="${g===current?'active':''}" onclick="pick(${i})">`+
+    `${esc(g)}</button>`).join("");
+  if (!current) { el("ops").innerHTML = "<p class=muted>waiting for "+
+    "reports…</p>"; return; }
+  const st = snap.reports[current];
+  el("badges").innerHTML =
+    `<span class=badge>${esc(st.Mode)}</span>`+
+    `<span class=badge>${esc(st.Time_policy)}</span>`+
+    `<span class=badge>threads ${st.Threads|0}</span>`+
+    `<span class="badge ${st.Dropped_tuples? 'warn':''}">dropped `+
+    `${fmt(st.Dropped_tuples)}</span>`;
+  let total = 0, rows = [];
+  opNames = (st.Operators||[]).map(o=>o.name);
+  (st.Operators||[]).forEach((o, oi) => {
+    const r = o.replicas, s = (k)=>r.reduce((a,x)=>a+(x[k]||0),0);
+    const tput = s("Throughput_tuples_sec"); total += tput;
+    rows.push(`<tr onclick="tog(${oi})"><td class=l>${esc(o.name)}</td>`+
+      `<td class=l>${esc(o.kind)}</td><td>${o.parallelism|0}</td>`+
+      `<td>${fmt(s("Inputs_received"))}</td>`+
+      `<td>${fmt(s("Outputs_sent"))}</td>`+
+      `<td>${fmt(s("Inputs_ignored"))}</td><td>${fmt(tput)}</td>`+
+      `<td>${fmt(Math.max(...r.map(x=>x.Service_time_usec||0)))}</td>`+
+      `<td>${fmt(s("Device_programs_run"))}</td>`+
+      `<td>${fmt(s("Staging_pool_hits"))}</td></tr>`);
+    if (open.has(o.name))
+      for (const x of r)
+        rows.push(`<tr class=rep><td class=l>&nbsp;&nbsp;replica `+
+          `${x.Replica_id}</td><td class=l>${x.isTerminated?"done":"run"}`+
+          `</td><td></td><td>${fmt(x.Inputs_received)}</td>`+
+          `<td>${fmt(x.Outputs_sent)}</td><td>${fmt(x.Inputs_ignored)}</td>`+
+          `<td>${fmt(x.Throughput_tuples_sec)}</td>`+
+          `<td>${fmt(x.Service_time_usec)}</td>`+
+          `<td>${fmt(x.Device_programs_run)}</td>`+
+          `<td>${fmt(x.Staging_pool_hits)}</td></tr>`);
+  });
+  el("ops").innerHTML =
+    `<table><tr><th class=l>operator</th><th class=l>kind</th><th>par</th>`+
+    `<th>in</th><th>out</th><th>ignored</th><th>tuples/s</th>`+
+    `<th>svc µs</th><th>device progs</th><th>pool hits</th></tr>`+
+    rows.join("")+`</table>`+
+    `<div class=muted>click an operator row for per-replica detail</div>`;
+  (hist[current] = hist[current]||[]).push(total);
+  if (hist[current].length > 120) hist[current].shift();
+  spark(hist[current]);
+  const svg = (snap.svgs||{})[current];  // server-sanitized
+  el("diagram").innerHTML = "<summary>dataflow graph</summary>"+
+    (svg || "<pre>"+esc(snap.diagrams[current]||"")+"</pre>");
+}
+function spark(h){
+  const c = el("spark"), ctx = c.getContext("2d");
+  ctx.clearRect(0,0,c.width,c.height);
+  if (!h.length) return;
+  const max = Math.max(...h, 1);
+  ctx.beginPath(); ctx.strokeStyle = "#2b6cb0"; ctx.lineWidth = 1.6;
+  h.forEach((v,i)=>{
+    const x = i*(c.width/120), y = c.height-4-(v/max)*(c.height-12);
+    i? ctx.lineTo(x,y) : ctx.moveTo(x,y);
+  });
+  ctx.stroke();
+  ctx.fillStyle="#555"; ctx.font="10px monospace";
+  ctx.fillText(fmt(max)+" t/s", 4, 10);
+}
+function pick(i){ current = graphList[i]; }
+function tog(i){ const n = opNames[i];
+  open.has(n)? open.delete(n) : open.add(n); }
+async function tick(){
+  try {
+    const r = await fetch("/json", {cache:"no-store"});
+    render(await r.json());
+    el("conn").textContent = "";
+  } catch (e) { el("conn").textContent = "(disconnected)"; }
+}
+setInterval(tick, 1000); tick();
+</script>
+</body>
+</html>
+"""
