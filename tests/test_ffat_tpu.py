@@ -207,11 +207,13 @@ def test_ffat_tpu_device_mode_segmentation():
     assert coll.results == expected
 
 
-def test_ffat_tpu_ring_alias_after_drain_iterations():
+@pytest.mark.parametrize("host_seg", [True, False])
+def test_ffat_tpu_ring_alias_after_drain_iterations(host_seg):
     """Regression: fire-only drain programs skip the level rebuild; window
     queries must clip to the data extent so ring slots aliasing panes
     evicted after the last rebuild never contribute (W_cap=2 forces long
-    drain chains; 3x ring wraparound exercises aliasing)."""
+    drain chains; 3x ring wraparound exercises aliasing). Runs in BOTH
+    segmentation modes — device mode is what executes on a real TPU."""
     import jax
     import numpy as np
     from windflow_tpu.basic import WinType
@@ -229,6 +231,7 @@ def test_ffat_tpu_ring_alias_after_drain_iterations():
         name="alias")
     op.build_replicas()
     rep = op.replicas[0]
+    rep._host_seg = host_seg
     got = {}
 
     class Cap:
@@ -268,3 +271,47 @@ def test_ffat_tpu_ring_alias_after_drain_iterations():
         for w in range(N_PANES - 3):
             expect = sum(p + 1 for p in range(w, min(w + 4, N_PANES)))
             assert got.get((k, w)) == expect, (k, w, got.get((k, w)), expect)
+
+
+def test_ffat_tpu_columnar_event_time_pipeline():
+    """push_columns -> keyed FFAT_TPU -> sink through the public API under
+    EVENT_TIME: every window sum checked, including the partial flush."""
+    import threading
+    import numpy as np
+    from windflow_tpu import Source_Builder, Sink_Builder, TimePolicy
+
+    K, N, WIN, SLIDE = 40, 30, 4000, 1000
+    graph = PipeGraph("ffat_cols", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for p in range(N):
+            shipper.set_next_watermark(p * 1000)
+            shipper.push_columns(
+                {"key": np.arange(K, dtype=np.int32),
+                 "value": np.full(K, p + 1, dtype=np.int32)},
+                ts=np.full(K, p * 1000 + 5, dtype=np.int64))
+        shipper.set_next_watermark(N * 1000 + WIN)
+
+    ffat = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+            .with_tb_windows(WIN, SLIDE)
+            .with_key_by("key").with_key_capacity(K)
+            .with_num_win_per_batch(64).build())
+    res, lock = {}, threading.Lock()
+
+    def sink(t):
+        if t is not None and t["valid"]:
+            with lock:
+                res[(t["key"], t["wid"])] = t["value"]
+
+    graph.add_source(Source_Builder(src).with_output_batch_size(K).build()) \
+         .add(ffat).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    for k in range(K):
+        for w in range(N):
+            panes = [p for p in range(w, w + 4) if p < N]
+            if not panes:
+                continue
+            assert res.get((k, w)) == sum(p + 1 for p in panes), (k, w)

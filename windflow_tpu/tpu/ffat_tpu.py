@@ -122,6 +122,17 @@ class FfatTPUReplica(TPUReplicaBase):
         self._step_cache: Dict[Any, Any] = {}
         self._fire_cache: Dict[Any, Any] = {}  # fire-only programs
         self.__host_seg = None  # resolved lazily: backend init is costly
+        self._check_index_plane()
+
+    def _check_index_plane(self) -> None:
+        """Every forest index (host composite sort, device scatter/evict
+        flat ids) lives in int32; enforced at init and after any growth —
+        in BOTH segmentation modes."""
+        if self.K_cap * 2 * self.F >= 2**31 - 1:
+            raise WindFlowError(
+                f"{self.op.name}: K_cap*2F = {self.K_cap * 2 * self.F} "
+                "overflows the int32 index plane; reduce key_capacity or "
+                "the window/slide ratio")
 
     @property
     def _host_seg(self) -> bool:
@@ -367,8 +378,9 @@ class FfatTPUReplica(TPUReplicaBase):
                         new[:len(lut)] = lut
                     lut = self._slot_lut = new
                 slots = lut[keys_arr]
-                if (slots < 0).any():
-                    for k in np.unique(keys_arr[slots < 0]):
+                miss = slots < 0
+                if miss.any():
+                    for k in np.unique(keys_arr[miss]):
                         lut[k] = self._slot(int(k))
                     slots = lut[keys_arr]
                 return slots.astype(np.int64)
@@ -400,6 +412,7 @@ class FfatTPUReplica(TPUReplicaBase):
                                     ).at[:old].set(self.tvalid)
         self._step_cache.clear()
         self._fire_cache.clear()
+        self._check_index_plane()
 
     def _grow_ring(self, needed_span: int) -> None:
         import jax
@@ -429,6 +442,7 @@ class FfatTPUReplica(TPUReplicaBase):
             self.tvalid = self.tvalid.at[sr, dc].set(old_valid[sr, sc])
         self._step_cache.clear()
         self._fire_cache.clear()
+        self._check_index_plane()
 
     def _ensure_forest(self, sample_fields) -> None:
         if self.trees is not None:
@@ -508,13 +522,8 @@ class FfatTPUReplica(TPUReplicaBase):
         live_p[:n] = live
         if self._host_seg:
             # int32 composite: the stable sort is the host hot spot and
-            # int32 sorts ~2x faster. The whole index plane (incl. flat_p
-            # below and the device programs) assumes node ids fit int32.
-            if self.K_cap * 2 * self.F >= 2**31 - 1:
-                raise WindFlowError(
-                    f"{op.name}: K_cap*2F = {self.K_cap * 2 * self.F} "
-                    "overflows the int32 index plane; reduce key_capacity "
-                    "or the window/slide ratio")
+            # int32 sorts ~2x faster (the int32 index plane is guaranteed
+            # by _check_index_plane at init/growth for BOTH seg modes)
             big = np.int32(self.K_cap * self.F)
             composite = np.where(live_p,
                                  slots_p.astype(np.int32)
