@@ -521,13 +521,17 @@ class FfatTPUReplica(TPUReplicaBase):
         live_p = np.zeros(cap, dtype=bool)
         live_p[:n] = live
         if self._host_seg:
-            # int32 composite: the stable sort is the host hot spot and
-            # int32 sorts ~2x faster (the int32 index plane is guaranteed
-            # by _check_index_plane at init/growth for BOTH seg modes)
-            big = np.int32(self.K_cap * self.F)
+            # The stable composite sort is the host hot spot. numpy's
+            # argsort takes a radix path for int16 (~12x the int64
+            # comparison sort), so use the narrowest dtype that holds
+            # K_cap*F (+1 for the sentinel); int32 is guaranteed by
+            # _check_index_plane at init/growth for BOTH seg modes.
+            M = self.K_cap * self.F
+            cdt = np.int16 if M < 2**15 - 1 else np.int32
+            big = cdt(M)
             composite = np.where(live_p,
-                                 slots_p.astype(np.int32)
-                                 * np.int32(self.F) + leafphys_p, big)
+                                 slots_p.astype(cdt) * cdt(self.F)
+                                 + leafphys_p.astype(cdt), big)
             order_p = np.argsort(composite, kind="stable").astype(np.int32)
             sc = composite[order_p]
             same_p = np.r_[False, sc[1:] == sc[:-1]]
