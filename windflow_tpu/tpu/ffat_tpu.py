@@ -103,7 +103,9 @@ class FfatTPUReplica(TPUReplicaBase):
         # (wf/builders_gpu.hpp has no analog; growth still works past it)
         self.K_cap = 1 << max(2, math.ceil(math.log2(op.key_capacity)))
         self.W_cap = op.num_win_per_batch
-        self.slot_of_key: Dict[Any, int] = {}
+        from .keymap import KeySlotMap
+        self._keymap = KeySlotMap(on_new=self._on_new_key)
+        self.slot_of_key = self._keymap.slot_of_key  # shared dict
         self._out_keys_by_slot: List[Any] = []
         # per-slot host bookkeeping (numpy, grown with K_cap)
         self.next_fire = np.zeros(self.K_cap, dtype=np.int64)
@@ -114,7 +116,6 @@ class FfatTPUReplica(TPUReplicaBase):
         # _out_keys_by_slot python list for non-int keys)
         self._keys_np = np.zeros(self.K_cap, dtype=np.int64)
         self._keys_all_int = True
-        self._slot_lut = None  # direct int-key -> slot table (_slots_of)
         self.ignored = 0
         # device forest (lazily shaped once the lift output is known)
         self.trees = None  # dict field -> (K_cap, 2F)
@@ -346,51 +347,18 @@ class FfatTPUReplica(TPUReplicaBase):
     # ==================================================================
     # host control plane
     # ==================================================================
-    def _slot(self, key) -> int:
-        s = self.slot_of_key.get(key)
-        if s is None:
-            s = self.slot_of_key[key] = len(self.slot_of_key)
-            self._out_keys_by_slot.append(key)
-            if s >= self.K_cap:
-                self._grow_keys()
-            if self._keys_all_int and isinstance(key, int):
-                self._keys_np[s] = key
-            else:
-                self._keys_all_int = False
-        return s
-
-    _LUT_MAX = 1 << 22  # 16 MiB int32 cap for the direct key->slot table
+    def _on_new_key(self, key, s: int) -> None:
+        """KeySlotMap callback: per-slot bookkeeping for a fresh key."""
+        self._out_keys_by_slot.append(key)
+        if s >= self.K_cap:
+            self._grow_keys()
+        if self._keys_all_int and isinstance(key, int):
+            self._keys_np[s] = key
+        else:
+            self._keys_all_int = False
 
     def _slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
-        """Vectorized key -> slot mapping. Small non-negative int keys go
-        through a direct lookup table (O(n), no sort); others fall back to
-        one ``_slot`` call per DISTINCT key via np.unique."""
-        if keys_arr.dtype.kind in "iu" and n:
-            kmin = int(keys_arr.min())
-            kmax = int(keys_arr.max())
-            if 0 <= kmin and kmax < self._LUT_MAX:
-                lut = self._slot_lut
-                if lut is None or kmax >= len(lut):
-                    size = min(self._LUT_MAX,
-                               1 << max(10, (kmax + 1).bit_length()))
-                    new = np.full(size, -1, dtype=np.int32)
-                    if lut is not None:
-                        new[:len(lut)] = lut
-                    lut = self._slot_lut = new
-                slots = lut[keys_arr]
-                miss = slots < 0
-                if miss.any():
-                    for k in np.unique(keys_arr[miss]):
-                        lut[k] = self._slot(int(k))
-                    slots = lut[keys_arr]
-                return slots.astype(np.int64)
-        if keys_arr.dtype.kind in "iu":
-            uniq, inverse = np.unique(keys_arr, return_inverse=True)
-            slot_map = np.fromiter((self._slot(int(k)) for k in uniq),
-                                   dtype=np.int64, count=len(uniq))
-            return slot_map[inverse]
-        return np.fromiter((self._slot(k) for k in keys),
-                           dtype=np.int64, count=n)
+        return self._keymap.slots_of(keys, keys_arr, n)
 
     def _grow_keys(self) -> None:
         import jax
@@ -473,14 +441,9 @@ class FfatTPUReplica(TPUReplicaBase):
             leaves = batch.ts_host[:n] // op.pane_len
         else:
             # CB: leaf = per-key arrival index (stable within the batch)
-            leaves = np.empty(n, dtype=np.int64)
-            order0 = np.argsort(slots, kind="stable")
-            ss = slots[order0]
-            seg_start = np.r_[True, ss[1:] != ss[:-1]]
-            grp = np.cumsum(seg_start) - 1
-            first_of = np.nonzero(seg_start)[0]
-            leaves[order0] = (self.count[ss[first_of[grp]]]
-                              + np.arange(n) - first_of[grp])
+            from .keymap import group_positions
+            _, within = group_positions(slots, self.K_cap)
+            leaves = self.count[slots] + within
             np.add.at(self.count, slots, 1)
         # align brand-new keys to the first window containing their first
         # leaf: without this, an epoch-scale first timestamp would demand a

@@ -192,11 +192,13 @@ class _KeyedStateScan:
     """
 
     def __init__(self, replica, func, state_init, filter_mode: bool) -> None:
+        from .keymap import KeySlotMap
         self.replica = replica
         self.func = func
         self.state_init = state_init
         self.filter_mode = filter_mode
-        self.slot_of_key: Dict[Any, int] = {}
+        self._keymap = KeySlotMap()
+        self.slot_of_key = self._keymap.slot_of_key  # shared dict
         self.table_capacity = 64
         self.table = None  # pytree of (table_capacity, ...) arrays
         self._cache: Dict[Any, Any] = {}
@@ -283,43 +285,32 @@ class _KeyedStateScan:
             self.table = jax.tree_util.tree_map(
                 lambda f, o: f.at[:o.shape[0]].set(o), fresh, old)
 
-    def _global_slot(self, k) -> int:
-        sl = self.slot_of_key.get(k)
-        if sl is None:
-            sl = self.slot_of_key[k] = len(self.slot_of_key)
-        return sl
-
     def grid_meta(self, batch: BatchTPU):
         """(grid_idx, valid, touched, touched_mask, M, KB): batch-local
         grid positions, the touched global table rows, and the grid
-        bucket sizes."""
+        bucket sizes. No comparison sort on the hot path: global slots
+        come from the KeySlotMap LUT; touched rows + dense local ids come
+        from a bincount when the table is batch-sized (falling back to
+        np.unique when total keys dwarf the batch — bincount would pay
+        O(table) per batch) and the grouping from a radix argsort."""
+        from .keymap import group_positions
+
         n = batch.size
         cap = batch.capacity
         keys = self.replica.batch_keys(batch)
         keys_arr = np.asarray(keys)
-        if n and keys_arr.dtype.kind in "iu":
-            # vectorized: one dict lookup per DISTINCT key
-            uniq, lslots = np.unique(keys_arr, return_inverse=True)
-            touched_list = [self._global_slot(int(k)) for k in uniq]
-        else:
-            local_of_global: Dict[int, int] = {}
-            lslots = np.zeros(n, dtype=np.int64)
-            touched_list = []
-            for i, k in enumerate(keys):
-                sl = self._global_slot(k)
-                ll = local_of_global.get(sl)
-                if ll is None:
-                    ll = local_of_global[sl] = len(local_of_global)
-                    touched_list.append(sl)
-                lslots[i] = ll
+        gslots = self._keymap.slots_of(keys, keys_arr, n)
         self._ensure_table(len(self.slot_of_key))
-        order0 = np.argsort(lslots, kind="stable")
-        ss = lslots[order0]
-        seg_start = np.r_[True, ss[1:] != ss[:-1]] if n else np.zeros(0, bool)
-        first_of = np.nonzero(seg_start)[0]
-        grp = np.cumsum(seg_start) - 1
-        within = np.empty(n, dtype=np.int64)
-        within[order0] = np.arange(n) - first_of[grp]
+        if self.table_capacity <= 4 * max(1, n):
+            # touched rows + dense local ids, O(n + table) via bincount
+            cnt = np.bincount(gslots, minlength=self.table_capacity)
+            touched_list = np.nonzero(cnt)[0]
+            lmap = np.zeros(self.table_capacity, dtype=np.int64)
+            lmap[touched_list] = np.arange(len(touched_list))
+            lslots = lmap[gslots]
+        else:  # high cardinality: O(n log n) beats O(table_capacity)
+            touched_list, lslots = np.unique(gslots, return_inverse=True)
+        _, within = group_positions(lslots, len(touched_list))
         max_depth = int(within.max()) + 1 if n else 1
         M = 1
         while M < max_depth:
