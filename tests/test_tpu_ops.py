@@ -473,3 +473,28 @@ def test_push_columns_validation():
         Sink_Builder(lambda t: None).build())
     with pytest.raises(WindFlowError, match="EVENT_TIME"):
         g2.run()
+
+
+def test_keyed_reduce_tuple_keys():
+    """Regression: composite (tuple) keys from a callable extractor take
+    the generic slot path — np.asarray of int tuples is 2-D and must not
+    enter the vectorized int fast paths."""
+    import threading
+    acc, lock = {}, threading.Lock()
+    graph = PipeGraph("tpu_tuple_keys", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+    src = (Source_Builder(make_ingress_source(4, 24))
+           .with_output_batch_size(8).build())
+    red = (Reduce_TPU_Builder(
+        lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+        .with_key_by(lambda t: (t.key, t.key % 2)).build())
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                acc[t.key] = acc.get(t.key, 0) + t.value
+
+    graph.add_source(src).add(red).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    total = sum(range(1, 25))
+    assert acc == {k: total for k in range(4)}

@@ -36,7 +36,12 @@ class KeySlotMap:
         return s
 
     def slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
-        """Vectorized mapping of a whole batch; int64 result of length n."""
+        """Vectorized mapping of a whole batch; int64 result of length n.
+        The int fast paths require a 1-D int array — tuple-of-int keys
+        become a 2-D array and must take the generic per-key path."""
+        if keys_arr.ndim != 1:
+            return np.fromiter((self.slot(k) for k in keys),
+                               dtype=np.int64, count=n)
         if keys_arr.dtype.kind in "iu" and n:
             kmin = int(keys_arr.min())
             kmax = int(keys_arr.max())
@@ -66,12 +71,12 @@ class KeySlotMap:
 
 
 def stable_group_argsort(vals: np.ndarray, n_groups: int) -> np.ndarray:
-    """Stable argsort of small non-negative group ids, using the narrowest
-    dtype so numpy's RADIX path applies (~12x the comparison sort)."""
+    """Stable argsort of small non-negative group ids. numpy's stable
+    sort takes a RADIX path for <=16-bit ints only (~12x the comparison
+    sort; int32/int64 both fall back to timsort, measured), so the cast
+    pays off exactly when the ids fit int16."""
     if n_groups < 2**15 - 1:
         return np.argsort(vals.astype(np.int16), kind="stable")
-    if n_groups < 2**31 - 1:
-        return np.argsort(vals.astype(np.int32), kind="stable")
     return np.argsort(vals, kind="stable")
 
 

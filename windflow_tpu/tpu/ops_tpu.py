@@ -101,13 +101,26 @@ class TPUReplicaBase(BasicReplica):
         return keys
 
     def batch_slots(self, batch: BatchTPU):
+        """Per-batch dense slot ids + slot->key order. Device ops run in
+        DEFAULT mode only, so intra-batch output order is free: int keys
+        take a vectorized unique (slot order = sorted keys), others keep
+        first-appearance order via the Python loop."""
         import jax
         keys = self.batch_keys(batch)
+        n = batch.size
+        keys_arr = np.asarray(keys)
+        # ndim guard: tuple-of-int keys become a 2-D int array
+        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
+            uniq, inv = np.unique(keys_arr[:n], return_inverse=True)
+            slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
+            slots[:n] = inv
+            slot_of_key = {int(k): i for i, k in enumerate(uniq)}
+            return jax.device_put(slots), slot_of_key
         slot_of_key: Dict[Any, int] = {}
         slots = np.zeros(batch.capacity, dtype=np.int32)
         for i, k in enumerate(keys):
             slots[i] = slot_of_key.setdefault(k, len(slot_of_key))
-        slots[batch.size:] = len(slot_of_key)  # padding segment
+        slots[n:] = len(slot_of_key)  # padding segment
         return jax.device_put(slots), slot_of_key
 
 
