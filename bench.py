@@ -47,7 +47,7 @@ SLIDE_US = 25_000
 TS_STEP = 50  # µs between tuples per key
 
 HC_KEYS = 10_240  # high-cardinality configuration
-HC_WIN_PER_BATCH = 2048
+HC_WIN_PER_BATCH = None  # auto-sized from key capacity
 HC_BATCHES = 24
 
 

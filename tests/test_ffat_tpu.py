@@ -315,3 +315,45 @@ def test_ffat_tpu_columnar_event_time_pipeline():
             if not panes:
                 continue
             assert res.get((k, w)) == sum(p + 1 for p in panes), (k, w)
+
+
+def test_ffat_tpu_tuple_keys():
+    """Composite (tuple) keys from a callable extractor: slot mapping and
+    window emission must take the object-key paths (regression: ragged
+    zero-padded asarray crashed at first fire)."""
+    import threading
+    import numpy as np
+    from windflow_tpu import Source_Builder, Sink_Builder, TimePolicy
+
+    N, WIN, SLIDE = 20, 4000, 1000
+    graph = PipeGraph("ffat_tuple_keys", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for p in range(N):
+            shipper.set_next_watermark(p * 1000)
+            for k in range(3):
+                shipper.push_with_timestamp(
+                    {"key": k, "value": p + 1}, p * 1000 + 5)
+        shipper.set_next_watermark(N * 1000 + WIN)
+
+    ffat = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+            .with_tb_windows(WIN, SLIDE)
+            .with_key_by(lambda t: (t["key"], t["key"] % 2))
+            .with_num_win_per_batch(4).build())
+    res, lock = {}, threading.Lock()
+
+    def sink(t):
+        if t is not None and t["valid"]:
+            with lock:
+                res[(t["wid"],)] = res.get((t["wid"],), 0) + t["value"]
+
+    graph.add_source(Source_Builder(src).with_output_batch_size(12).build()) \
+         .add(ffat).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    # 3 tuple-keys each contribute sum(p+1 for p in window) to window w
+    for w in range(N - 3):
+        expect = 3 * sum(p + 1 for p in range(w, w + 4))
+        assert res.get((w,)) == expect, (w, res.get((w,)), expect)

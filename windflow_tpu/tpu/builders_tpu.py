@@ -106,7 +106,7 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         self._slide_len = 0
         self._win_type = None
         self._lateness = 0
-        self._nwpb = 16
+        self._nwpb = None  # default: auto-sized from key capacity
         self._key_capacity = 16
 
     def with_key_capacity(self, n: int):

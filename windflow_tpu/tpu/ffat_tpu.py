@@ -61,7 +61,7 @@ class Ffat_Windows_TPU(TPUOperatorBase):
     def __init__(self, lift: Callable, combine: Callable, key_extractor,
                  win_len: int, slide_len: int,
                  win_type: WinType = WinType.TB, lateness: int = 0,
-                 num_win_per_batch: int = 16,
+                 num_win_per_batch: Optional[int] = None,
                  name: str = "ffat_windows_tpu", parallelism: int = 1,
                  output_batch_size: int = 0,
                  schema: Optional[TupleSchema] = None,
@@ -78,8 +78,15 @@ class Ffat_Windows_TPU(TPUOperatorBase):
         self.slide_len = slide_len
         self.win_type = win_type
         self.lateness = lateness
-        self.num_win_per_batch = max(1, num_win_per_batch)
         self.key_capacity = max(1, key_capacity)
+        if num_win_per_batch is None:
+            # fired windows per step scale with key count (each key slides
+            # its own windows): default the fire-batch budget to the key
+            # capacity so high-cardinality streams don't drain through
+            # many tiny programs (the reference leaves numWinPerBatch
+            # manual, builders_gpu.hpp:576)
+            num_win_per_batch = max(16, min(4096, self.key_capacity))
+        self.num_win_per_batch = max(1, num_win_per_batch)
         self.pane_len = math.gcd(win_len, slide_len)
 
     def build_replicas(self) -> None:
@@ -102,7 +109,12 @@ class FfatTPUReplica(TPUReplicaBase):
         # pre-sizing the key table avoids growth recompiles
         # (wf/builders_gpu.hpp has no analog; growth still works past it)
         self.K_cap = 1 << max(2, math.ceil(math.log2(op.key_capacity)))
+        # two-tier fire budget: the full per-batch program carries a SMALL
+        # window budget (most batches fire few windows; keeps the always-
+        # paid vmapped-query cost low), drain iterations and data-less
+        # firing use the full W_cap so backlogs clear in few programs
         self.W_cap = op.num_win_per_batch
+        self.W_step = min(self.W_cap, 64)
         from .keymap import KeySlotMap
         self._keymap = KeySlotMap(on_new=self._on_new_key)
         self.slot_of_key = self._keymap.slot_of_key  # shared dict
@@ -514,10 +526,10 @@ class FfatTPUReplica(TPUReplicaBase):
                        live_p, order_p, same_p, end_p, flat_p, frontier)
 
     # ------------------------------------------------------------------
-    def _fireable(self, frontier, partial: bool):
+    def _fireable(self, frontier, partial: bool, budget: int):
         """Fire-eligible windows as per-slot chunk ARRAYS
         (slots, start0, k, wid0, max_leaf), each chunk covering the slot's
-        consecutive eligible windows, truncated to the W_cap budget.
+        consecutive eligible windows, truncated to ``budget``.
 
         Fully vectorized: one numpy pass over the live slot table per call
         (C-speed even at 10^5 keys; the reference instead walks its key
@@ -547,9 +559,9 @@ class FfatTPUReplica(TPUReplicaBase):
         if slots.size == 0:
             return empty
         k = k[slots]
-        # W_cap budget: clip the chunk sequence where the cumsum crosses
+        # budget: clip the chunk sequence where the cumsum crosses
         before = np.cumsum(k) - k
-        k = np.minimum(k, self.W_cap - before)
+        k = np.minimum(k, budget - before)
         keep = k > 0
         slots, k = slots[keep], k[keep]
         start0 = self.next_fire[slots].copy()
@@ -565,12 +577,12 @@ class FfatTPUReplica(TPUReplicaBase):
         before = np.cumsum(k) - k
         return np.arange(tot, dtype=np.int64) - np.repeat(before, k)
 
-    def _pack_fire_arrays(self, chunks, n_out):
+    def _pack_fire_arrays(self, chunks, n_out, W: int):
         """Chunk arrays -> padded fire/evict arrays for the device
-        programs. Pure numpy (repeat + segmented arange): zero per-window
-        or per-chunk Python."""
+        programs (shaped for budget ``W``; jit re-traces per shape). Pure
+        numpy (repeat + segmented arange): zero per-window or per-chunk
+        Python."""
         c_slots, c_start0, c_k, c_wid0, c_ml = chunks
-        W = self.W_cap
         E = max(1, W * self.slide_units)
         f_slots = np.zeros(W, dtype=np.int32)
         f_starts = np.zeros(W, dtype=np.int32)
@@ -615,6 +627,23 @@ class FfatTPUReplica(TPUReplicaBase):
             fs = self._fire_cache[fkey] = self._make_fire_step()
         return fs
 
+    def _warm_fire_step(self) -> None:
+        """Compile the fire-only program EAGERLY (masked no-op run):
+        its first real use is mid-stream on a fire burst, and a ~0.5s
+        compile there would land inside the measured/latency-critical
+        path instead of startup."""
+        if self.trees is None:
+            return
+        W = self.W_cap
+        E = max(1, W * self.slide_units)
+        z32 = np.zeros(W, dtype=np.int32)
+        self._fire_step()(self.trees, self.tvalid, z32, z32,
+                          np.zeros(W, dtype=np.int32),
+                          np.zeros(W, dtype=bool),
+                          np.zeros(E, dtype=np.int32),
+                          np.zeros(E, dtype=np.int32),
+                          np.zeros(E, dtype=bool))
+
     def _run_step(self, fields, wm, cap, slots_p, leafphys_p, live_p,
                   order_p, same_p, end_p, flat_p, frontier,
                   partial: bool = False) -> None:
@@ -634,19 +663,21 @@ class FfatTPUReplica(TPUReplicaBase):
             flat_p = np.zeros(1, dtype=np.int32)
         first = True
         while True:
-            chunks = self._fireable(frontier, partial)
+            budget = self.W_step if first else self.W_cap
+            chunks = self._fireable(frontier, partial, budget)
             n_out = int(chunks[2].sum())
             if not first and not n_out:
                 break
             (f_slots, f_starts, f_lens, f_mask, wids,
              e_slots, e_leaves, e_mask) = self._pack_fire_arrays(
-                chunks, n_out)
+                chunks, n_out, budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
                 ckey = (cap, self.K_cap, self.F, self._host_seg)
                 step = self._step_cache.get(ckey)
                 if step is None:
                     step = self._step_cache[ckey] = self._make_step(cap)
+                    self._warm_fire_step()
                 self.trees, self.tvalid, qr, qv = step(
                     fields, slots_p, leafphys_p, live_p, order_p, same_p,
                     end_p, flat_p, self.trees, self.tvalid,
@@ -660,36 +691,40 @@ class FfatTPUReplica(TPUReplicaBase):
                     e_slots, e_leaves, e_mask)
             self.stats.device_programs_run += 1
             if n_out:
-                self._emit_windows(wm, chunks, n_out, wids, qr, qv)
+                self._emit_windows(wm, chunks, n_out, wids, qr, qv, budget)
             first = False
-            if n_out < self.W_cap:
+            if n_out < budget:
                 break
 
-    def _emit_windows(self, wm, chunks, n_out, wids, qr, qv) -> None:
+    def _emit_windows(self, wm, chunks, n_out, wids, qr, qv,
+                      W: int) -> None:
         import jax
 
         op = self.op
-        pad = self.W_cap - n_out
+        pad = W - n_out
         fields = dict(qr)
         fields["valid"] = qv
-        wid_col = np.zeros(self.W_cap, dtype=np.int32)
+        wid_col = np.zeros(W, dtype=np.int32)
         wid_col[:n_out] = wids
         fields["wid"] = jax.device_put(wid_col)
         c_slots, _st, c_k, _w0, _ml = chunks
         slot_per_win = np.repeat(c_slots, c_k)
         if self._keys_all_int:
             out_keys: Any = self._keys_np[slot_per_win]  # numpy, no boxing
-            key_col = np.zeros(self.W_cap, dtype=np.int64)
-            key_col[:n_out] = out_keys
         else:
+            # composite/object keys (callable extractors): host metadata
+            # only — key_field is always a numeric column, so no key
+            # COLUMN is built on this branch (a zero-padded asarray of
+            # tuples would be ragged)
             out_keys = [self._out_keys_by_slot[s] for s in slot_per_win]
-            key_col = np.asarray(list(out_keys) + [0] * pad)
         if op.key_field is not None:
             kd = getattr(self, "_key_dtype", np.dtype(np.int32))
+            key_col = np.zeros(W, dtype=np.int64)
+            key_col[:n_out] = out_keys
             fields[op.key_field] = jax.device_put(key_col.astype(kd))
         out_schema = TupleSchema(
             {name: np.dtype(v.dtype) for name, v in fields.items()})
-        ts = np.full(self.W_cap, wm, dtype=np.int64)
+        ts = np.full(W, wm, dtype=np.int64)
         out = BatchTPU(fields, ts, n_out, out_schema, wm, out_keys)
         self._emit_batch(out)
 
@@ -700,18 +735,19 @@ class FfatTPUReplica(TPUReplicaBase):
         if self.trees is None:
             return
         while True:
-            chunks = self._fireable(frontier, partial)
+            chunks = self._fireable(frontier, partial, self.W_cap)
             n_out = int(chunks[2].sum())
             if not n_out:
                 return
             (f_slots, f_starts, f_lens, f_mask, wids,
              e_slots, e_leaves, e_mask) = self._pack_fire_arrays(
-                chunks, n_out)
+                chunks, n_out, self.W_cap)
             self.tvalid, qr, qv = self._fire_step()(
                 self.trees, self.tvalid, f_slots, f_starts, f_lens, f_mask,
                 e_slots, e_leaves, e_mask)
             self.stats.device_programs_run += 1
-            self._emit_windows(self.cur_wm, chunks, n_out, wids, qr, qv)
+            self._emit_windows(self.cur_wm, chunks, n_out, wids, qr, qv,
+                               self.W_cap)
             if n_out < self.W_cap:
                 return
 
