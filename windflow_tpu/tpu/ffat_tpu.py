@@ -88,6 +88,13 @@ class Ffat_Windows_TPU(TPUOperatorBase):
             num_win_per_batch = max(16, min(4096, self.key_capacity))
         self.num_win_per_batch = max(1, num_win_per_batch)
         self.pane_len = math.gcd(win_len, slide_len)
+        # compiled programs shared ACROSS replicas: cache keys carry every
+        # shape parameter (cap, K_cap, F, seg mode), so equal-config
+        # replicas reuse one compile instead of paying parallelism x
+        # (lock: replica worker threads race their first batch)
+        import threading
+        self._prog_cache: Dict[Any, Any] = {}
+        self._prog_lock = threading.Lock()
 
     def build_replicas(self) -> None:
         self.replicas = [FfatTPUReplica(self, i)
@@ -132,8 +139,7 @@ class FfatTPUReplica(TPUReplicaBase):
         # device forest (lazily shaped once the lift output is known)
         self.trees = None  # dict field -> (K_cap, 2F)
         self.tvalid = None  # (K_cap, 2F) bool
-        self._step_cache: Dict[Any, Any] = {}
-        self._fire_cache: Dict[Any, Any] = {}  # fire-only programs
+        self._prog_cache = op._prog_cache  # shared across replicas
         self.__host_seg = None  # resolved lazily: backend init is costly
         self._check_index_plane()
 
@@ -390,8 +396,6 @@ class FfatTPUReplica(TPUReplicaBase):
                 .at[:old].set(t), self.trees)
             self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool
                                     ).at[:old].set(self.tvalid)
-        self._step_cache.clear()
-        self._fire_cache.clear()
         self._check_index_plane()
 
     def _grow_ring(self, needed_span: int) -> None:
@@ -420,8 +424,6 @@ class FfatTPUReplica(TPUReplicaBase):
                 lambda new, old: new.at[sr, dc].set(old[sr, sc]),
                 self.trees, old_trees)
             self.tvalid = self.tvalid.at[sr, dc].set(old_valid[sr, sc])
-        self._step_cache.clear()
-        self._fire_cache.clear()
         self._check_index_plane()
 
     def _ensure_forest(self, sample_fields) -> None:
@@ -621,10 +623,13 @@ class FfatTPUReplica(TPUReplicaBase):
                 e_slots, e_leaves, e_mask)
 
     def _fire_step(self):
-        fkey = (self.K_cap, self.F)
-        fs = self._fire_cache.get(fkey)
+        fkey = ("fire", self.K_cap, self.F)
+        fs = self._prog_cache.get(fkey)
         if fs is None:
-            fs = self._fire_cache[fkey] = self._make_fire_step()
+            with self.op._prog_lock:
+                fs = self._prog_cache.get(fkey)
+                if fs is None:
+                    fs = self._prog_cache[fkey] = self._make_fire_step()
         return fs
 
     def _warm_fire_step(self) -> None:
@@ -634,6 +639,8 @@ class FfatTPUReplica(TPUReplicaBase):
         path instead of startup."""
         if self.trees is None:
             return
+        if ("fire", self.K_cap, self.F) in self._prog_cache:
+            return  # already compiled (e.g. a new batch-capacity bucket)
         W = self.W_cap
         E = max(1, W * self.slide_units)
         z32 = np.zeros(W, dtype=np.int32)
@@ -673,10 +680,14 @@ class FfatTPUReplica(TPUReplicaBase):
                 chunks, n_out, budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
-                ckey = (cap, self.K_cap, self.F, self._host_seg)
-                step = self._step_cache.get(ckey)
+                ckey = ("step", cap, self.K_cap, self.F, self._host_seg)
+                step = self._prog_cache.get(ckey)
                 if step is None:
-                    step = self._step_cache[ckey] = self._make_step(cap)
+                    with self.op._prog_lock:
+                        step = self._prog_cache.get(ckey)
+                        if step is None:
+                            step = self._prog_cache[ckey] = \
+                                self._make_step(cap)
                     self._warm_fire_step()
                 self.trees, self.tvalid, qr, qv = step(
                     fields, slots_p, leafphys_p, live_p, order_p, same_p,
@@ -718,10 +729,12 @@ class FfatTPUReplica(TPUReplicaBase):
             # tuples would be ragged)
             out_keys = [self._out_keys_by_slot[s] for s in slot_per_win]
         if op.key_field is not None:
+            # build directly in the key column's dtype (float keys must
+            # not round-trip through int64)
             kd = getattr(self, "_key_dtype", np.dtype(np.int32))
-            key_col = np.zeros(W, dtype=np.int64)
+            key_col = np.zeros(W, dtype=kd)
             key_col[:n_out] = out_keys
-            fields[op.key_field] = jax.device_put(key_col.astype(kd))
+            fields[op.key_field] = jax.device_put(key_col)
         out_schema = TupleSchema(
             {name: np.dtype(v.dtype) for name, v in fields.items()})
         ts = np.full(W, wm, dtype=np.int64)

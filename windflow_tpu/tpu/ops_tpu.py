@@ -213,8 +213,17 @@ class _KeyedStateScan:
         self._keymap = KeySlotMap()
         self.slot_of_key = self._keymap.slot_of_key  # shared dict
         self.table_capacity = 64
+        # compiled grid-scan programs shared across replicas of the op
+        # (keyed by grid shape; the table capacity is read from the table
+        # ARGUMENT at trace time, so growth re-traces automatically)
+        import threading
+        op = replica.op
+        if not hasattr(op, "_scan_prog_cache"):
+            op._scan_prog_cache = {}
+            op._scan_prog_lock = threading.Lock()
+        self._cache = op._scan_prog_cache
+        self._cache_lock = op._scan_prog_lock
         self.table = None  # pytree of (table_capacity, ...) arrays
-        self._cache: Dict[Any, Any] = {}
 
     # -- device program ----------------------------------------------------
     def _make(self, M: int, KB: int):
@@ -289,7 +298,6 @@ class _KeyedStateScan:
                                    dtype=jnp.asarray(v).dtype), init)
         while n_keys_needed > self.table_capacity:
             self.table_capacity *= 2
-            self._cache.clear()
             old = self.table
             fresh = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
@@ -345,7 +353,10 @@ class _KeyedStateScan:
         ckey = (M, KB)
         prog = self._cache.get(ckey)
         if prog is None:
-            prog = self._cache[ckey] = self._make(M, KB)
+            with self._cache_lock:
+                prog = self._cache.get(ckey)
+                if prog is None:
+                    prog = self._cache[ckey] = self._make(M, KB)
         return prog
 
 
