@@ -24,7 +24,12 @@ def _strip_remote_backends():
         # freezing jax_platforms from the pre-override environment
         jax.config.update("jax_platforms", "cpu")
         from jax._src import xla_bridge as xb
-        for name in [n for n in list(xb._backend_factories) if n != "cpu"]:
+        # keep 'tpu' REGISTERED (never initialized under
+        # JAX_PLATFORMS=cpu): pallas registers TPU lowering rules at
+        # import and needs the platform to be known. Only tunnel-dialing
+        # factories (axon) are the hang hazard.
+        for name in [n for n in list(xb._backend_factories)
+                     if n not in ("cpu", "tpu")]:
             xb._backend_factories.pop(name, None)
     except Exception:
         pass

@@ -240,6 +240,16 @@ class FfatTPUReplica(TPUReplicaBase):
         tmap = jax.tree_util.tree_map
         comb_valid, window_query = self._query_fns()
 
+        # optional pallas level-rebuild (WF_PALLAS=1): one VMEM round-trip
+        # per key block instead of one HBM materialization per level; the
+        # interpreter validates it off-TPU
+        pallas_rebuild = None
+        from .pallas_kernels import make_forest_rebuild, pallas_enabled
+        if pallas_enabled() and self.trees is not None and K_cap >= 8:
+            pallas_rebuild = make_forest_rebuild(
+                combine, list(self.trees.keys()), F,
+                interpret=jax.default_backend() != "tpu")
+
         def step(fields, slots, leaves_phys, live, h_order, h_same, h_end,
                  h_flat, trees, tvalid,
                  fire_slots, fire_starts, fire_lens, fire_mask,
@@ -293,19 +303,22 @@ class FfatTPUReplica(TPUReplicaBase):
                 True, mode="drop").reshape(tvalid.shape)
 
             # 3. rebuild internal levels across the whole forest
-            lvl = F >> 1
-            while lvl >= 1:
-                lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
-                rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
-                vlc = tvalid[:, 2 * lvl:4 * lvl:2]
-                vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
-                merged = combine(lc, rc)
-                node = tmap(lambda m, a, b: jnp.where(
-                    vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
-                trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
-                             trees, node)
-                tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
-                lvl >>= 1
+            if pallas_rebuild is not None:
+                trees, tvalid = pallas_rebuild(trees, tvalid)
+            else:
+                lvl = F >> 1
+                while lvl >= 1:
+                    lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
+                    rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
+                    vlc = tvalid[:, 2 * lvl:4 * lvl:2]
+                    vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
+                    merged = combine(lc, rc)
+                    node = tmap(lambda m, a, b: jnp.where(
+                        vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
+                    trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
+                                 trees, node)
+                    tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
+                    lvl >>= 1
 
             # 4. fired-window queries (vmapped over W_cap)
             ftrees = tmap(lambda t: t[fire_slots], trees)
@@ -623,14 +636,10 @@ class FfatTPUReplica(TPUReplicaBase):
                 e_slots, e_leaves, e_mask)
 
     def _fire_step(self):
-        fkey = ("fire", self.K_cap, self.F)
-        fs = self._prog_cache.get(fkey)
-        if fs is None:
-            with self.op._prog_lock:
-                fs = self._prog_cache.get(fkey)
-                if fs is None:
-                    fs = self._prog_cache[fkey] = self._make_fire_step()
-        return fs
+        from .ops_tpu import cached_compile
+        return cached_compile(self._prog_cache, self.op._prog_lock,
+                              ("fire", self.K_cap, self.F),
+                              self._make_fire_step)
 
     def _warm_fire_step(self) -> None:
         """Compile the fire-only program EAGERLY (masked no-op run):
@@ -680,14 +689,12 @@ class FfatTPUReplica(TPUReplicaBase):
                 chunks, n_out, budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
+                from .ops_tpu import cached_compile
                 ckey = ("step", cap, self.K_cap, self.F, self._host_seg)
-                step = self._prog_cache.get(ckey)
-                if step is None:
-                    with self.op._prog_lock:
-                        step = self._prog_cache.get(ckey)
-                        if step is None:
-                            step = self._prog_cache[ckey] = \
-                                self._make_step(cap)
+                fresh = ckey not in self._prog_cache
+                step = cached_compile(self._prog_cache, self.op._prog_lock,
+                                      ckey, lambda: self._make_step(cap))
+                if fresh:
                     self._warm_fire_step()
                 self.trees, self.tvalid, qr, qv = step(
                     fields, slots_p, leafphys_p, live_p, order_p, same_p,

@@ -36,6 +36,19 @@ from .batch import BatchTPU, key_column_to_list
 from .schema import TupleSchema
 
 
+def cached_compile(cache: Dict, lock, key, make):
+    """Compile-once lookup shared by every device-program cache
+    (double-checked locking: replica worker threads race their first
+    batch)."""
+    prog = cache.get(key)
+    if prog is None:
+        with lock:
+            prog = cache.get(key)
+            if prog is None:
+                prog = cache[key] = make()
+    return prog
+
+
 # ---------------------------------------------------------------------------
 # shared replica machinery
 # ---------------------------------------------------------------------------
@@ -131,9 +144,13 @@ class TPUOperatorBase(BasicOperator):
     def __init__(self, name: str, parallelism: int, input_routing: RoutingMode,
                  key_extractor, output_batch_size: int,
                  schema: Optional[TupleSchema]) -> None:
+        import threading
         super().__init__(name, parallelism, input_routing, key_extractor,
                          output_batch_size)
         self.schema = schema  # None => inferred at the staging boundary
+        # compiled device programs shared across this op's replicas
+        self._scan_prog_cache: Dict[Any, Any] = {}
+        self._scan_prog_lock = threading.Lock()
 
     @property
     def is_chainable(self) -> bool:
@@ -216,11 +233,7 @@ class _KeyedStateScan:
         # compiled grid-scan programs shared across replicas of the op
         # (keyed by grid shape; the table capacity is read from the table
         # ARGUMENT at trace time, so growth re-traces automatically)
-        import threading
         op = replica.op
-        if not hasattr(op, "_scan_prog_cache"):
-            op._scan_prog_cache = {}
-            op._scan_prog_lock = threading.Lock()
         self._cache = op._scan_prog_cache
         self._cache_lock = op._scan_prog_lock
         self.table = None  # pytree of (table_capacity, ...) arrays
@@ -350,14 +363,8 @@ class _KeyedStateScan:
         return grid_idx, valid, touched, touched_mask, M, KB
 
     def program(self, M: int, KB: int):
-        ckey = (M, KB)
-        prog = self._cache.get(ckey)
-        if prog is None:
-            with self._cache_lock:
-                prog = self._cache.get(ckey)
-                if prog is None:
-                    prog = self._cache[ckey] = self._make(M, KB)
-        return prog
+        return cached_compile(self._cache, self._cache_lock, (M, KB),
+                              lambda: self._make(M, KB))
 
 
 class StatefulMapTPUReplica(TPUReplicaBase):

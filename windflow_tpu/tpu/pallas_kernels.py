@@ -1,0 +1,101 @@
+"""Pallas TPU kernels for the FFAT forest hot path.
+
+The forest level rebuild is the per-batch fixed cost of the flagship
+operator: for every key row, internal node ``i`` at each level is
+``combine(node[2i], node[2i+1])`` with validity (an invalid child passes
+the other through). The XLA lowering materializes every level's
+``at[...].set`` back to HBM; this kernel instead loads a block of key
+rows into VMEM ONCE, rebuilds all ``log2(F)`` levels with in-register
+``jnp`` ops, and writes the finished rows back — one HBM round-trip per
+block instead of one per level (reference counterpart:
+``wf/flatfat_gpu.hpp:338-395``, per-level ``Update_TreeLevel_Kernel``
+launches).
+
+Gated by ``WF_PALLAS=1`` (used automatically only on TPU backends; the
+interpreter validates the kernel on CPU in tests). The user ``combine``
+is inlined into the kernel body — any jax-traceable combine works.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("WF_PALLAS", "0") == "1"
+
+
+def make_forest_rebuild(combine: Callable, field_names, F: int,
+                        k_block: int = 8, interpret: bool = False):
+    """Returns ``rebuild(trees: dict, tvalid) -> (trees, tvalid)`` where
+    trees values and tvalid are (K_cap, 2F) arrays whose leaf half
+    ``[F:2F)`` is current; internal nodes ``[1:F)`` are recomputed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    names = list(field_names)
+    NNODES = 2 * F
+
+    def kernel(*refs):
+        n = len(names)
+        in_vals = [refs[i][...] for i in range(n)]        # (KB, 2F) each
+        in_valid = refs[n][...]                           # (KB, 2F) bool
+        out_vals = refs[n + 1:2 * n + 1]
+        out_valid = refs[2 * n + 1]
+        # fold upward entirely in VMEM, collecting every level as VALUES;
+        # assemble the whole output row with one concatenate + ONE
+        # full-row store per ref (narrow lane-slice stores are a Mosaic
+        # lowering hazard)
+        level = {nm: v[:, F:NNODES] for nm, v in zip(names, in_vals)}
+        lvalid = in_valid[:, F:NNODES]
+        parts = {nm: [level[nm]] for nm in names}  # leaves first
+        vparts = [lvalid]
+        width = F
+        while width > 1:
+            half = width // 2
+            pair = {nm: v.reshape(v.shape[0], half, 2)
+                    for nm, v in level.items()}
+            lc = {nm: p[:, :, 0] for nm, p in pair.items()}
+            rc = {nm: p[:, :, 1] for nm, p in pair.items()}
+            pv = lvalid.reshape(lvalid.shape[0], half, 2)
+            vlc, vrc = pv[:, :, 0], pv[:, :, 1]
+            merged = combine(lc, rc)
+            level = {nm: jnp.where(vlc & vrc, merged[nm],
+                                   jnp.where(vlc, lc[nm], rc[nm]))
+                     for nm in names}
+            lvalid = vlc | vrc
+            for nm in names:
+                parts[nm].append(level[nm])
+            vparts.append(lvalid)
+            width = half
+        # row layout: [unused node 0][levels top-down][leaves]
+        for i, (nm, ov) in enumerate(zip(names, out_vals)):
+            row = jnp.concatenate(
+                [in_vals[i][:, 0:1]] + parts[nm][::-1], axis=1)
+            ov[...] = row
+        out_valid[...] = jnp.concatenate(
+            [in_valid[:, 0:1]] + vparts[::-1], axis=1)
+
+    def rebuild(trees: Dict, tvalid):
+        K_cap = tvalid.shape[0]
+        if K_cap < 8:
+            return None  # below the sublane tile; caller uses the XLA path
+        kb = min(k_block, K_cap)
+        grid = (K_cap // kb,)
+        blk = lambda: pl.BlockSpec((kb, NNODES), lambda i: (i, 0))
+        in_specs = [blk() for _ in range(len(names) + 1)]
+        out_specs = [blk() for _ in range(len(names) + 1)]
+        out_shapes = ([jax.ShapeDtypeStruct((K_cap, NNODES),
+                                            trees[nm].dtype)
+                       for nm in names]
+                      + [jax.ShapeDtypeStruct((K_cap, NNODES), jnp.bool_)])
+        outs = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shapes, interpret=interpret,
+        )(*[trees[nm] for nm in names], tvalid)
+        new_trees = {nm: o for nm, o in zip(names, outs[:len(names)])}
+        return new_trees, outs[len(names)]
+
+    return rebuild
