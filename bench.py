@@ -107,9 +107,11 @@ def _make_replica(n_keys: int, win_per_batch: int):
 class _CountingEmitter:
     def __init__(self):
         self.windows = 0
+        self.last_batch = None  # device-sync anchor (block on its fields)
 
     def emit_device_batch(self, b):
         self.windows += b.size
+        self.last_batch = b
 
     def set_stats(self, s):
         pass
@@ -121,37 +123,51 @@ class _CountingEmitter:
         pass
 
 
-def _run_config(n_keys: int, win_per_batch: int, n_batches: int):
-    """Returns (tuples/s, windows/s, p99 fire latency µs, programs)."""
+def _stage_batches(n_keys: int, n_batches: int, seed: int,
+                   with_ts: bool):
+    """Pre-staged synthetic keyed batches (staging excluded from timing:
+    the metric is the device-operator path, matching the reference's
+    per-operator counters). with_ts drives event-time/watermarks for the
+    window benchmark; plain arange timestamps otherwise."""
     import jax
     import numpy as np
 
     from windflow_tpu.tpu.batch import BatchTPU
     from windflow_tpu.tpu.schema import TupleSchema
 
-    rep = _make_replica(n_keys, win_per_batch)
-    sink = _CountingEmitter()
-    rep.emitter = sink
-
-    # pre-stage synthetic batches (staging excluded: the metric is the
-    # device-operator path, matching the reference's per-operator counters)
     schema = TupleSchema({"key": np.int32, "value": np.int32})
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     batches = []
     ts0 = 0
-    for _ in range(n_batches + WARMUP):
+    for _ in range(n_batches):
         keys = rng.integers(0, n_keys, BATCH).astype(np.int64)
         cols = {
             "key": jax.device_put(keys.astype(np.int32)),
             "value": jax.device_put(
                 rng.integers(0, 100, BATCH).astype(np.int32)),
         }
-        ts = ts0 + np.arange(BATCH, dtype=np.int64) * TS_STEP // N_KEYS
-        ts0 = int(ts[-1]) + TS_STEP
-        b = BatchTPU(cols, ts, BATCH, schema, wm=max(0, int(ts[0]) - 1000),
-                     host_keys=keys)  # numpy key metadata: no boxing
-        b.wm = int(ts[-1])
+        if with_ts:
+            ts = ts0 + np.arange(BATCH, dtype=np.int64) * TS_STEP // N_KEYS
+            ts0 = int(ts[-1]) + TS_STEP
+            b = BatchTPU(cols, ts, BATCH, schema,
+                         wm=max(0, int(ts[0]) - 1000),
+                         host_keys=keys)  # numpy key metadata: no boxing
+            b.wm = int(ts[-1])
+        else:
+            b = BatchTPU(cols, np.arange(BATCH, dtype=np.int64), BATCH,
+                         schema, host_keys=keys)
         batches.append(b)
+    return batches
+
+
+def _run_config(n_keys: int, win_per_batch: int, n_batches: int):
+    """Returns (tuples/s, windows/s, p99 fire latency µs, programs)."""
+    import jax
+
+    rep = _make_replica(n_keys, win_per_batch)
+    sink = _CountingEmitter()
+    rep.emitter = sink
+    batches = _stage_batches(n_keys, n_batches + WARMUP, 0, with_ts=True)
 
     for b in batches[:WARMUP]:
         rep.handle_msg(0, b)
@@ -179,6 +195,34 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int):
             rep.stats.device_programs_run)
 
 
+def _sync(sink: "_CountingEmitter") -> None:
+    """Wait for the device to drain: block on the LAST emitted batch's
+    columns (works for every op type; completion of the last program
+    implies all earlier ones on the single dispatch queue)."""
+    import jax
+
+    if sink.last_batch is not None:
+        jax.block_until_ready(list(sink.last_batch.fields.values()))
+
+
+def _run_op_config(make_op, n_keys: int, n_batches: int):
+    """Generic device-op throughput: pre-staged keyed batches -> op."""
+    op = make_op()
+    op.build_replicas()
+    rep = op.replicas[0]
+    sink = _CountingEmitter()
+    rep.emitter = sink
+    bs = _stage_batches(n_keys, n_batches + WARMUP, 1, with_ts=False)
+    for b in bs[:WARMUP]:
+        rep.handle_msg(0, b)
+    _sync(sink)  # warmup compute must not bleed into the timed region
+    t0 = time.perf_counter()
+    for b in bs[WARMUP:]:
+        rep.handle_msg(0, b)
+    _sync(sink)
+    return n_batches * BATCH / (time.perf_counter() - t0)
+
+
 def main() -> None:
     fallback = os.environ.get("WF_BENCH_FALLBACK") == "1"
     if not fallback and not _probe_backend():
@@ -198,6 +242,23 @@ def main() -> None:
     print(f"bench: {HC_KEYS} keys -> {hc_tps:,.0f} t/s, {hc_wps:,.0f} win/s",
           file=sys.stderr)
 
+    # secondary device ops (one line each in the JSON extras)
+    import jax.numpy as jnp
+
+    from windflow_tpu.tpu.ops_tpu import Map_TPU, Reduce_TPU
+
+    smap_tps = _run_op_config(
+        lambda: Map_TPU(lambda row, st: ({**row, "value": row["value"]
+                                          + st["n"]}, {"n": st["n"] + 1}),
+                        key_extractor="key", state_init={"n": jnp.int32(0)},
+                        name="bench_smap"), 64, 24)
+    kred_tps = _run_op_config(
+        lambda: Reduce_TPU(lambda a, b: {"key": b["key"],
+                                         "value": a["value"] + b["value"]},
+                           key_extractor="key", name="bench_kred"), 256, 24)
+    print(f"bench: stateful map {smap_tps:,.0f} t/s, "
+          f"keyed reduce {kred_tps:,.0f} t/s", file=sys.stderr)
+
     metric = "ffat_sliding_window_tuples_per_sec_per_chip"
     if fallback or platform == "cpu":
         metric += " (cpu-fallback)"
@@ -211,6 +272,8 @@ def main() -> None:
         "hc_keys": HC_KEYS,
         "hc_tuples_per_sec": round(hc_tps, 1),
         "hc_windows_per_sec": round(hc_wps, 1),
+        "stateful_map_tuples_per_sec": round(smap_tps, 1),
+        "keyed_reduce_tuples_per_sec": round(kred_tps, 1),
     }))
 
 
