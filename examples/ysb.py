@@ -31,6 +31,7 @@ from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
 from windflow_tpu.kafka import Kafka_Source_Builder, MemoryBroker
 
 USE_TPU = os.environ.get("YSB_CPU") != "1"
+BATCH = int(os.environ.get("YSB_BATCH", "4096"))
 N_CAMPAIGNS = 100
 ADS_PER_CAMPAIGN = 10
 WIN_US = 10_000_000  # 10s tumbling windows
@@ -86,14 +87,14 @@ def main(n_events: int = 60_000) -> None:
     src = (Kafka_Source_Builder(deser).with_brokers("memory://ysb")
            .with_topics("ad_events").with_idleness(100)
            .with_parallelism(2)
-           .with_output_batch_size(1024 if USE_TPU else 0).build())
+           .with_output_batch_size(BATCH if USE_TPU else 0).build())
     views = Filter_Builder(lambda e: e.event_type == 0).with_parallelism(2) \
-        .with_output_batch_size(1024 if USE_TPU else 0).build()
+        .with_output_batch_size(BATCH if USE_TPU else 0).build()
     # ad -> campaign join against the static campaign table
     project = (Map_Builder(lambda e: CampaignEvent(
                    e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts, e.ing))
                .with_parallelism(2)
-               .with_output_batch_size(1024 if USE_TPU else 0).build())
+               .with_output_batch_size(BATCH if USE_TPU else 0).build())
 
     if USE_TPU:
         from windflow_tpu.tpu import Ffat_Windows_TPU_Builder

@@ -1,0 +1,74 @@
+"""bench.py probe discipline: probes are detached, never killed, retried
+with a deadline — the relay-safety contract PERF.md documents."""
+
+import os
+import sys
+import types
+
+
+def _load_bench(monkeypatch, fake_popen):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import importlib
+    import bench
+    importlib.reload(bench)
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    return bench
+
+
+def test_probe_success(monkeypatch):
+    class P:
+        def __init__(self, *a, **k):
+            assert k.get("start_new_session"), "probe must be detached"
+
+        def poll(self):
+            return 0
+
+    bench = _load_bench(monkeypatch, P)
+    assert bench._probe_backend() is True
+
+
+def test_probe_error_retries_then_gives_up(monkeypatch):
+    calls = []
+
+    class P:
+        def __init__(self, *a, **k):
+            calls.append(1)
+
+        def poll(self):
+            return 1  # UNAVAILABLE-style failure
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_BENCH_PROBE_ATTEMPTS", "3")
+    assert bench._probe_backend() is False
+    assert len(calls) == 3
+
+
+def test_probe_deadline_abandons_without_kill(monkeypatch):
+    killed = []
+
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return None  # hangs forever
+
+        def kill(self):  # pragma: no cover - must never run
+            killed.append(1)
+
+        terminate = kill
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setenv("WF_BENCH_PROBE_DEADLINE", "0.05")
+    t = [0.0]
+
+    def mono():
+        t[0] += 0.03
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is False
+    assert not killed, "probe must be abandoned, not killed"

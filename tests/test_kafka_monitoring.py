@@ -198,3 +198,26 @@ def test_diagram_svg_render(tmp_path):
     # graphviz output (when a dot binary exists) starts with an XML
     # prolog; the built-in renderer starts directly with <svg
     assert svg_file.exists() and b"<svg" in svg_file.read_bytes()[:512]
+
+
+def test_dashboard_rejects_active_svg_content():
+    """Diagram data arrives over an unauthenticated TCP port: SVG with
+    scripts/handlers must never reach the dashboard HTML; the escaped dot
+    source is served instead."""
+    from windflow_tpu.monitoring.monitor import _safe_diagram
+
+    bad = ['<svg><script>fetch("x")</script></svg>',
+           '<svg onload="alert(1)"><rect/></svg>',
+           '<svg/onload=alert(1)><rect/></svg>',      # no-space delimiter
+           '<svg\tonerror=x><rect/></svg>',
+           '<svg><foreignObject><body>x</body></foreignObject></svg>',
+           '<svg><a href="javascript:alert(1)">x</a></svg>',
+           '<svg><a href="java&#115;cript:alert(1)">x</a></svg>',
+           '<svg><a href="  data:text/html,x">x</a></svg>',
+           '<div>not svg</div>']
+    for svg in bad:
+        out = _safe_diagram(svg, "digraph g { a -> b }")
+        assert "<script" not in out and "onload" not in out, svg
+        assert out.startswith("<pre>") and "a -&gt; b" in out
+    ok = '<svg xmlns="http://www.w3.org/2000/svg"><rect width="5"/></svg>'
+    assert _safe_diagram(ok, "") == ok

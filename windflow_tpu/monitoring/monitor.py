@@ -71,19 +71,24 @@ class MonitoringThread(threading.Thread):
 
 def _safe_diagram(svg, dot: str) -> str:
     """Diagram data arrives over an unauthenticated TCP port, so it is
-    untrusted: embed the SVG only when it carries no active content
-    (inline SVG may legally contain <script>/event handlers), otherwise
-    fall back to the HTML-escaped dot source."""
+    untrusted: embed the SVG only when it provably carries no active
+    content, otherwise fall back to the HTML-escaped dot source. The
+    checks are deliberately over-broad (reject-by-default): legitimate
+    diagrams come from our own renderer or Graphviz, which emit none of
+    the rejected constructs — entity references, scripts, event handlers
+    (any delimiter: space, /, quote), foreignObject, or URI schemes."""
     import html as _html
     import re
 
     if svg:
         low = svg.lower()
         if (low.lstrip().startswith("<svg")
-                and "<script" not in low
-                and "javascript:" not in low
+                and "script" not in low          # <script>, entity-split
+                and "&#" not in low              # numeric entities
                 and "<foreignobject" not in low
-                and not re.search(r"\son\w+\s*=", low)):
+                and not re.search(r"""[\s/"'=]on\w+\s*=""", low)
+                and not re.search(r"""(javascript|data|vbscript)\s*:""",
+                                  low)):
             return svg
     return f"<pre>{_html.escape(dot)}</pre>"
 
