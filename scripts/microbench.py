@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Reproducible microbenchmarks behind the PERF.md numbers.
+
+Run: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python scripts/microbench.py
+(or on a TPU host with the tunnel healthy, leave the env alone).
+
+Prints one JSON line per microbenchmark. These are the component-level
+measurements; `bench.py` remains the driver-facing headline metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def report(name: str, value: float, unit: str = "tuples/sec") -> None:
+    print(json.dumps({"bench": name, "value": round(value, 1),
+                      "unit": unit}))
+
+
+class _NullPort:
+    def send(self, m):
+        pass
+
+    def send_eos(self):
+        pass
+
+
+def bench_staging() -> None:
+    from windflow_tpu.tpu.emitters_tpu import TPUStageEmitter
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    N, B = 500_000, 16384
+    schema = TupleSchema({"key": np.int32, "value": np.int32})
+    em = TPUStageEmitter(1, B, schema, None, "forward")
+    em.set_ports([_NullPort()])
+    row = {"key": 3, "value": 7}
+    t0 = time.perf_counter()
+    for i in range(N):
+        em.emit(row, i, 0)
+    em.flush()
+    report("staging_per_row", N / (time.perf_counter() - t0))
+
+    em2 = TPUStageEmitter(1, B, schema, None, "forward")
+    em2.set_ports([_NullPort()])
+    keys = np.zeros(B, np.int32)
+    vals = np.zeros(B, np.int32)
+    ts = np.arange(B, dtype=np.int64)
+    t0 = time.perf_counter()
+    for _ in range(N // B):
+        em2.emit_columns({"key": keys, "value": vals}, ts, 0)
+    report("staging_push_columns", (N // B) * B / (time.perf_counter() - t0))
+
+    em3 = TPUStageEmitter(4, B, schema, None, "keyby", key_field="key")
+    em3.set_ports([_NullPort()] * 4)
+    rkeys = np.random.default_rng(0).integers(0, 64, B).astype(np.int32)
+    t0 = time.perf_counter()
+    for _ in range(N // B):
+        em3.emit_columns({"key": rkeys, "value": vals}, ts, 0)
+    report("staging_push_columns_keyby4",
+           (N // B) * B / (time.perf_counter() - t0))
+
+
+def bench_reshard() -> None:
+    import jax
+
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.emitters_tpu import TPUKeyByEmitter
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    B, DESTS = 16384, 4
+    schema = TupleSchema({"key": np.int32, "value": np.int32})
+    em = TPUKeyByEmitter(lambda t: t, DESTS, key_field="key")
+    em.set_ports([_NullPort()] * DESTS)
+    rng = np.random.default_rng(0)
+    bs = []
+    for _ in range(24):
+        keys = rng.integers(0, 1024, B).astype(np.int64)
+        cols = {"key": jax.device_put(keys.astype(np.int32)),
+                "value": jax.device_put(
+                    rng.integers(0, 100, B).astype(np.int32))}
+        bs.append(BatchTPU(cols, np.arange(B, dtype=np.int64), B, schema,
+                           host_keys=keys))
+    for b in bs[:4]:
+        em.emit_device_batch(b)
+    t0 = time.perf_counter()
+    for b in bs[4:]:
+        em.emit_device_batch(b)
+    report("tpu_keyed_reshard_4dests", 20 * B / (time.perf_counter() - t0))
+
+
+def bench_channels() -> None:
+    import threading
+
+    from windflow_tpu.runtime.channel import Channel
+
+    N = 200_000
+    ch = Channel(2048)
+    ch.register_input()
+
+    def consumer():
+        for _ in range(N):
+            ch.get()
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    msg = ("x", 1)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        ch.put(0, msg)
+    t.join()
+    report("python_channel", N / (time.perf_counter() - t0), "msg/sec")
+
+    from windflow_tpu.native import NativeChannel, native_available
+    if native_available():
+        nch = NativeChannel(2048)
+        nch.register_input()
+
+        def nconsumer():
+            for _ in range(N):
+                nch.get()
+
+        t = threading.Thread(target=nconsumer)
+        t.start()
+        t0 = time.perf_counter()
+        for _ in range(N):
+            nch.put(0, msg)
+        t.join()
+        report("native_channel", N / (time.perf_counter() - t0), "msg/sec")
+
+
+def bench_exit_decode() -> None:
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    n = 200_000
+    schema = TupleSchema({"a": np.int32, "b": np.float32})
+    cols = {"a": np.arange(n, dtype=np.int32),
+            "b": np.arange(n, dtype=np.float32)}
+    ts = np.arange(n, dtype=np.int64)
+    t0 = time.perf_counter()
+    rows = schema.from_columns(cols, ts, n)
+    assert len(rows) == n
+    report("exit_from_columns", n / (time.perf_counter() - t0), "rows/sec")
+
+
+def main() -> None:
+    bench_staging()
+    bench_reshard()
+    bench_channels()
+    bench_exit_decode()
+
+
+if __name__ == "__main__":
+    main()

@@ -221,3 +221,22 @@ def test_dashboard_rejects_active_svg_content():
         assert out.startswith("<pre>") and "a -&gt; b" in out
     ok = '<svg xmlns="http://www.w3.org/2000/svg"><rect width="5"/></svg>'
     assert _safe_diagram(ok, "") == ok
+
+
+def test_sanitizer_accepts_own_renderer_output():
+    """Names with apostrophes / 'script' substrings must still render:
+    the built-in renderer escapes only &<>, so its output passes the
+    reject-by-default sanitizer."""
+    from windflow_tpu import PipeGraph, Sink_Builder, Source_Builder
+    from windflow_tpu.monitoring.monitor import _safe_diagram
+
+    g = PipeGraph("bob's descriptor graph")
+
+    def src(shipper):
+        shipper.push({"v": 1})
+
+    g.add_source(Source_Builder(src).with_name("bob's source").build()) \
+     .add_sink(Sink_Builder(lambda t: None).with_name("descriptor").build())
+    g.run()
+    svg = g.to_svg()
+    assert _safe_diagram(svg, "dot") == svg, "own renderer output rejected"

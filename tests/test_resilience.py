@@ -100,3 +100,46 @@ def test_stop_and_resume_from_offsets_and_durable_state(tmp_path):
     for i in range(N):
         expected[i % 3] = expected.get(i % 3, 0) + i + 1
     assert state == expected
+
+
+def test_many_graphs_no_leak():
+    """Soak: many graphs in one process must not accumulate state (program
+    caches die with their ops; channels/workers are per-graph)."""
+    import gc
+
+    from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    def one(i):
+        acc = []
+        g = PipeGraph(f"soak{i}", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+
+        def src(shipper):
+            for v in range(200):
+                shipper.push({"v": v})
+
+        g.add_source(Source_Builder(src).with_output_batch_size(32).build()) \
+         .add(Map_TPU_Builder(lambda f: {"v": f["v"] + 1}).build()) \
+         .add(Map_Builder(lambda t: t).build()) \
+         .add_sink(Sink_Builder(lambda t: acc.append(t) if t else None)
+                   .build())
+        g.run()
+        assert len(acc) == 200
+
+    def rss_kb() -> int:  # CURRENT rss (not the high-water mark, which
+        # any earlier test in the process could have set)
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * 4  # pages -> kB
+
+    for i in range(3):  # warmup: compiles + allocator pools
+        one(i)
+    gc.collect()
+    rss0 = rss_kb()
+    for i in range(20):
+        one(100 + i)
+    gc.collect()
+    rss1 = rss_kb()
+    # 20 more graphs must not grow the resident set by more than ~200MB
+    assert rss1 - rss0 < 200_000, (rss0, rss1)

@@ -77,9 +77,12 @@ def stages_to_svg(stages: List, title: str = "") -> str:
         'refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" fill="#555"/>'
         "</marker></defs>",
     ]
+    # escape only &<> (quote=False): quote escaping would emit &#x27;
+    # numeric entities that the dashboard's reject-by-default sanitizer
+    # refuses (an apostrophe is legal XML text as-is)
     if title:
         out.append(f'<text x="{_PAD}" y="16" font-size="13" '
-                   f'fill="#333">{html.escape(title)}</text>')
+                   f'fill="#333">{html.escape(title, quote=False)}</text>')
     for s in stages:  # edges under boxes
         x1, y1 = pos[s.id]
         for e in s.upstreams:
@@ -98,7 +101,7 @@ def stages_to_svg(stages: List, title: str = "") -> str:
         x, y = pos[s.id]
         # truncate BEFORE escaping: clipping an entity mid-way would make
         # the standalone .svg invalid XML
-        label = html.escape(s.describe()[:22])
+        label = html.escape(s.describe()[:22], quote=False)
         par = "|".join(str(o.parallelism) for o in s.ops)
         is_dev = any(getattr(o, "is_tpu", False) for o in s.ops)
         fill = "#e8f0fe" if is_dev else "#f5f5f5"

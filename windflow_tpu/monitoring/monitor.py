@@ -83,8 +83,10 @@ def _safe_diagram(svg, dot: str) -> str:
     if svg:
         low = svg.lower()
         if (low.lstrip().startswith("<svg")
-                and "script" not in low          # <script>, entity-split
-                and "&#" not in low              # numeric entities
+                and "<script" not in low
+                and "&#" not in low              # numeric entities (the
+                # built-in renderer escapes only &<> — see stages_to_svg)
+                and "&colon" not in low
                 and "<foreignobject" not in low
                 and not re.search(r"""[\s/"'=]on\w+\s*=""", low)
                 and not re.search(r"""(javascript|data|vbscript)\s*:""",
@@ -183,6 +185,9 @@ class MonitoringServer:
                 self.wfile.write(data)
 
             def do_GET(self):
+                import html as _html
+
+                esc = _html.escape
                 snap = server.snapshot()
                 # untrusted diagram data is sanitized for every HTML/JSON
                 # consumer (the client injects the svg via innerHTML);
@@ -218,16 +223,21 @@ class MonitoringServer:
                                       for r in reps)
                             ign = sum(r.get("Inputs_ignored", 0)
                                       for r in reps)
+                            # report fields arrive over the untrusted
+                            # monitoring port: escape before interpolation
                             ops.append(
-                                f"<tr><td>{o['name']}</td><td>{o['kind']}"
-                                f"</td><td>{o['parallelism']}</td>"
+                                f"<tr><td>{esc(str(o['name']))}</td>"
+                                f"<td>{esc(str(o['kind']))}</td>"
+                                f"<td>{int(o['parallelism'])}</td>"
                                 f"<td>{tin}</td><td>{tout}</td><td>{ign}</td>"
                                 f"<td>{tput:,.0f}</td><td>{svc:.1f}</td>"
                                 f"<td>{dev}</td></tr>")
                         rows.append(
-                            f"<h2>{g} <small>[{st.get('Mode')}] threads="
-                            f"{st.get('Threads')} dropped="
-                            f"{st.get('Dropped_tuples')}</small></h2>"
+                            f"<h2>{esc(str(g))} <small>"
+                            f"[{esc(str(st.get('Mode')))}] threads="
+                            f"{int(st.get('Threads') or 0)} dropped="
+                            f"{int(st.get('Dropped_tuples') or 0)}"
+                            f"</small></h2>"
                             f"<table border=1 cellpadding=4 "
                             f"style='border-collapse:collapse'>"
                             f"<tr><th>op</th><th>kind</th><th>par</th>"

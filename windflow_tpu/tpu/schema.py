@@ -130,10 +130,20 @@ class TupleSchema:
         loop this replaces) — the D2H exit is a hot boundary."""
         names = self._names
         ctor = self.constructor
-        ts_list = ts[:n].tolist()
+        ts_list = np.asarray(ts[:n], dtype=np.int64).tolist()
+        if len(ts_list) != n:
+            raise WindFlowError(f"from_columns: ts holds {len(ts_list)} "
+                                f"rows, batch claims {n}")
         if not names:  # ts-only tuples: zip(*[]) would silently drop rows
             return [({}, t) for t in ts_list]
-        lists = [np.asarray(cols[name])[:n].tolist() for name in names]
+        lists = []
+        for name in names:
+            col = np.asarray(cols[name])[:n].tolist()
+            if len(col) != n:  # zip would TRUNCATE silently
+                raise WindFlowError(
+                    f"from_columns: column {name!r} holds {len(col)} rows, "
+                    f"batch claims {n}")
+            lists.append(col)
         if ctor is not None:
             # kwargs: an explicit schema's field order may not match the
             # constructor's positional order
