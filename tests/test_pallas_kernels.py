@@ -72,10 +72,21 @@ def test_forest_rebuild_multifield_noncommutative():
     assert (np.asarray(trees["y"])[:, 1:] == exp["y"][:, 1:]).all()
 
 
-def test_ffat_with_pallas_rebuild_end_to_end(monkeypatch):
+@pytest.mark.parametrize("host_seg", [True, False])
+def test_ffat_with_pallas_rebuild_end_to_end(monkeypatch, host_seg):
     """WF_PALLAS=1 routes the forest rebuild through the kernel (interpreter
-    off-TPU): a full FFAT pipeline must produce identical windows."""
+    off-TPU): a full FFAT pipeline must produce identical windows — in
+    BOTH segmentation modes (host_seg=False is the real-TPU shape)."""
     import threading
+    import windflow_tpu.tpu.ffat_tpu as ft
+    if not host_seg:
+        orig_init = ft.FfatTPUReplica.__init__
+
+        def forced(self, op, idx):
+            orig_init(self, op, idx)
+            self._host_seg = False
+
+        monkeypatch.setattr(ft.FfatTPUReplica, "__init__", forced)
     from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
                               Source_Builder, TimePolicy)
     from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
@@ -112,6 +123,7 @@ def test_ffat_with_pallas_rebuild_end_to_end(monkeypatch):
         graph.run()
         return res
 
+    monkeypatch.delenv("WF_PALLAS", raising=False)  # XLA-path baseline
     base = run_once()
     monkeypatch.setenv("WF_PALLAS", "1")
     with_pallas = run_once()

@@ -4,8 +4,6 @@ topology spec — op kinds, constants, parallelisms, batch sizes, mode —
 and the SAME spec drives both the PipeGraph and an independent Python
 model; the checksum must match exactly)."""
 
-import threading
-
 from hypothesis import given, settings, strategies as st
 
 from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
@@ -40,10 +38,9 @@ def model(spec):
     return sum(out), len(out)
 
 
-def build_ops(spec, plane, rng_draw):
+def build_ops(spec, plane, par):
     ops = []
     for op in spec:
-        par = rng_draw
         if plane == "tpu":
             if op[0] == "map":
                 c, d = op[1], op[2]
@@ -71,8 +68,8 @@ def build_ops(spec, plane, rng_draw):
 
 
 def run_pipeline(spec, plane, par, batch, mode):
-    total = [0, 0]
-    lock = threading.Lock()
+    from common import GlobalSum
+    acc = GlobalSum()
     graph = PipeGraph("prop", mode, TimePolicy.INGRESS_TIME)
 
     def src(shipper):
@@ -82,9 +79,7 @@ def run_pipeline(spec, plane, par, batch, mode):
 
     def sink(t):
         if t is not None:
-            with lock:
-                total[0] += t["value"]
-                total[1] += 1
+            acc.add(t["value"])
 
     mp = graph.add_source(
         Source_Builder(src).with_parallelism(par)
@@ -93,7 +88,7 @@ def run_pipeline(spec, plane, par, batch, mode):
         mp = mp.add(op)
     mp.add_sink(Sink_Builder(sink).build())
     graph.run()
-    return tuple(total)
+    return (acc.value, acc.count)
 
 
 @settings(max_examples=12, deadline=None)
