@@ -157,7 +157,14 @@ class FfatTPUReplica(TPUReplicaBase):
     def _host_seg(self) -> bool:
         if self.__host_seg is None:
             import jax
-            self.__host_seg = jax.default_backend() == "cpu"
+
+            from ..basic import env_flag
+            if env_flag("WF_FORCE_DEVICE_SEG"):
+                # CI lever: exercise the accelerator segmentation path
+                # (in-program sort) on the CPU backend across the suite
+                self.__host_seg = False
+            else:
+                self.__host_seg = jax.default_backend() == "cpu"
         return self.__host_seg
 
     @_host_seg.setter
