@@ -110,3 +110,46 @@ def test_sharded_ffat_forest_multistep():
         expect = sum(pane_sums.get((k, p), 0.0) for p in range(w, w + WIN))
         assert abs(got - expect) < 1e-3, (k, w, got, expect)
     assert len(fired) > 10  # the fire rounds actually fired
+
+
+@needs_multi
+def test_sharded_ffat_forest_slide_gt_one():
+    """Non-unit slide: window w covers panes [w*slide, w*slide+win)."""
+    from windflow_tpu.parallel import make_key_mesh, sharded_ffat_forest
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_key_mesh(8)
+    WIN, SLIDE = 5, 2
+    init_fn, step, (K_pad, k_local, GB) = sharded_ffat_forest(
+        mesh, lift=lambda v: {"x": v["x"]},
+        combine=lambda a, b: {"x": a["x"] + b["x"]},
+        n_keys=9, win_panes=WIN, slide_panes=SLIDE, local_batch=16,
+        fire_rounds=2)
+    state = init_fn({"x": np.zeros(1, np.float32)})
+    sh = NamedSharding(mesh, P(("key", "data")))
+    rng = np.random.default_rng(11)
+    pane_sums, fired = {}, {}
+    for it in range(8):
+        keys = rng.integers(0, 9, GB).astype(np.int32)
+        vals = rng.integers(1, 6, GB).astype(np.float32)
+        panes = (rng.integers(0, 3, GB) + it * 2).astype(np.int32)
+        for k, v, p in zip(keys, vals, panes):
+            pane_sums[(int(k), int(p))] = pane_sums.get(
+                (int(k), int(p)), 0.0) + float(v)
+        out = step(*state, jax.device_put(keys, sh),
+                   {"x": jax.device_put(vals, sh)},
+                   jax.device_put(panes, sh), np.int32(it * 2 + 2))
+        state = out[:5]
+        rv = np.asarray(out[6])
+        rx = np.asarray(out[5]["x"])
+        rw = np.asarray(out[7])
+        for krow in range(K_pad):
+            for r in range(rv.shape[1]):
+                if rv[krow, r]:
+                    fired[(krow, int(rw[krow, r]))] = float(rx[krow, r])
+    assert len(fired) > 10
+    for (k, w), got in fired.items():
+        start = w * SLIDE
+        exp = sum(pane_sums.get((k, p), 0.0)
+                  for p in range(start, start + WIN))
+        assert abs(got - exp) < 1e-3, (k, w, got, exp)
