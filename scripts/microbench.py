@@ -150,12 +150,39 @@ def bench_exit_decode() -> None:
     report("exit_from_columns", n / (time.perf_counter() - t0), "rows/sec")
 
 
+def bench_cpu_plane() -> None:
+    """Per-tuple Python plane: 3-op chain end-to-end (the CPU plane is
+    functor-bound by design; the device plane is the throughput story)."""
+    from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
+                              PipeGraph, Sink_Builder, Source_Builder,
+                              TimePolicy)
+
+    N = 300_000
+    seen = [0]
+
+    def src(shipper):
+        for v in range(N):
+            shipper.push({"v": v})
+
+    g = PipeGraph("cpu_plane", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(src).build()) \
+     .add(Map_Builder(lambda t: {"v": t["v"] + 1}).build()) \
+     .add(Filter_Builder(lambda t: t["v"] % 10 != 0).build()) \
+     .add_sink(Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                            if t else None).build())
+    t0 = time.perf_counter()
+    g.run()
+    report("cpu_plane_3op_chain", N / (time.perf_counter() - t0))
+
+
 def main() -> None:
     bench_staging()
     bench_reshard()
     bench_channels()
     bench_exit_decode()
+    bench_cpu_plane()
 
 
 if __name__ == "__main__":
     main()
+
