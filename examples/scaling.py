@@ -2,7 +2,8 @@
 keyed device windowing operator at parallelism N (the reference's
 strategy 2 — KEYBY partitioning — applied to the flagship operator).
 
-Run: JAX_PLATFORMS=cpu python examples/scaling.py [n_replicas] [n_batches]
+Run: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python examples/scaling.py [par] [batches]
+(unsetting PALLAS_AXON_POOL_IPS skips the single-claim TPU tunnel)
 
 Each source replica pushes whole numpy columns (`push_columns`, no
 per-tuple Python); the keyed staging boundary partitions them by the

@@ -11,7 +11,8 @@ wall-clock through the whole pipeline (a relative-µs int32 column on the
 device plane); the sink reports p50/p99 of (emit wall - last contributing
 event's ingest wall) per fired window, on BOTH planes.
 
-Run: JAX_PLATFORMS=cpu python examples/ysb.py [n_events]
+Run: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python examples/ysb.py [n_events]
+(unsetting PALLAS_AXON_POOL_IPS skips the single-claim TPU tunnel)
 (or on a TPU host with the device backend available, leave JAX_PLATFORMS
 unset; YSB_CPU=1 selects the CPU window operator.)
 """
