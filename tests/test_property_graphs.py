@@ -110,3 +110,40 @@ def test_random_cpu_pipeline_matches_model(spec, par, batch, mode):
     exp_sum, exp_n = model(spec)
     assert run_pipeline(spec, "cpu", par, batch, mode) \
         == (exp_sum * par, exp_n * par)
+
+
+@settings(max_examples=10, deadline=None)
+@given(spec=op_spec, par=st.integers(1, 2),
+       batch=st.sampled_from([8, 32]), rpar=st.integers(1, 3))
+def test_random_pipeline_with_keyed_reduce(spec, par, batch, rpar):
+    """Terminal keyed Reduce_TPU: emitted partial sums per batch make the
+    COUNT batching-dependent, but the SUM is invariant — it must equal
+    the model's total regardless of parallelism or batch shape."""
+    from common import GlobalSum
+    from windflow_tpu.tpu import Reduce_TPU_Builder
+
+    acc = GlobalSum()
+    graph = PipeGraph("prop_red", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+
+    def src(shipper):
+        for v in range(1, STREAM + 1):
+            for k in range(N_KEYS):
+                shipper.push({"key": k, "value": v})
+
+    def sink(t):
+        if t is not None:
+            acc.add(t["value"])
+
+    mp = graph.add_source(
+        Source_Builder(src).with_parallelism(par)
+        .with_output_batch_size(batch).build())
+    for op in build_ops(spec, "tpu", par):
+        mp = mp.add(op)
+    mp = mp.add(Reduce_TPU_Builder(
+        lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+        .with_key_by("key").with_parallelism(rpar).build())
+    mp.add_sink(Sink_Builder(sink).build())
+    graph.run()
+    exp_sum, _ = model(spec)
+    assert acc.value == exp_sum * par
