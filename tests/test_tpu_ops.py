@@ -498,3 +498,20 @@ def test_keyed_reduce_tuple_keys():
     graph.run()
     total = sum(range(1, 25))
     assert acc == {k: total for k in range(4)}
+
+
+def test_filter_tpu_integer_mask():
+    """Regression: a predicate returning an int 0/1 column (not bool)
+    must compact correctly (bitwise ~ on ints corrupted the scatter)."""
+    acc = GlobalSum()
+    graph = PipeGraph("tpu_intmask", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+    src = (Source_Builder(make_ingress_source(3, 40))
+           .with_output_batch_size(16).build())
+    f = Filter_TPU_Builder(lambda c: c["value"] % 2).build()  # int mask
+    graph.add_source(src).add(f).add_sink(
+        Sink_Builder(make_sum_sink(acc)).build())
+    graph.run()
+    odds = [v for v in range(1, 41) if v % 2]
+    assert acc.value == 3 * sum(odds)
+    assert acc.count == 3 * len(odds)

@@ -36,6 +36,20 @@ from .batch import BatchTPU, key_column_to_list
 from .schema import TupleSchema
 
 
+def _compact_order(keep):
+    """Stable keepers-first permutation as GATHER indices, via cumsum +
+    one scatter — equivalent to ``argsort(~keep, stable)`` but O(n)
+    scatter instead of a sort (~11x on CPU, sorts are costly on TPU)."""
+    import jax.numpy as jnp
+
+    keep = keep.astype(bool)  # int 0/1 masks: ~keep would be bitwise NOT
+    p_keep = jnp.cumsum(keep) - 1
+    p_drop = jnp.sum(keep) + jnp.cumsum(~keep) - 1
+    pos = jnp.where(keep, p_keep, p_drop).astype(jnp.int32)
+    return jnp.zeros(keep.shape[0], jnp.int32).at[pos].set(
+        jnp.arange(keep.shape[0], dtype=jnp.int32))
+
+
 def cached_compile(cache: Dict, lock, key, make):
     """Compile-once lookup shared by every device-program cache
     (double-checked locking: replica worker threads race their first
@@ -288,7 +302,7 @@ class _KeyedStateScan:
             if filter_mode:
                 keep = outs.reshape(-1)[row_flat]  # (cap,) bool
                 keep = keep & valid
-                order = jnp.argsort(~keep, stable=True)
+                order = _compact_order(keep)  # keepers first, stable
                 out = {k: v[order] for k, v in fields.items()}
                 return out, order, jnp.sum(keep), table2
             out_rows = {f: (o.reshape(M * KB, -1)[row_flat].reshape(
@@ -443,7 +457,7 @@ class FilterTPUReplica(TPUReplicaBase):
         def run(fields, size):
             n = next(iter(fields.values())).shape[0]
             keep = pred(fields) & (jnp.arange(n) < size)
-            order = jnp.argsort(~keep, stable=True)  # keepers first, in order
+            order = _compact_order(keep)  # keepers first, stable
             out = {k: v[order] for k, v in fields.items()}
             return out, order, jnp.sum(keep)
 
