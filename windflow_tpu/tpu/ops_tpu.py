@@ -9,13 +9,15 @@ Siblings of the reference CUDA operators (``wf/map_gpu.hpp``,
 - ``jax.jit`` is instantiated once per operator; XLA's own cache handles
   one compile per capacity bucket (the reference caches launch configs per
   batch size, ``map_gpu.hpp:251-277``);
-- Filter compacts via a stable sort on the keep-mask (the reference uses
-  ``thrust::copy_if``, ``filter_gpu.hpp:331-335``);
-- Reduce sorts by key slot and runs a segmented associative scan with the
-  user's combine, gathering segment tails — one result per key per batch,
-  exactly the reference semantics (``reduce_gpu.hpp:239-272``:
-  sort_by_key + reduce_by_key). The combine must be associative and
-  commutative (``API:78-80``);
+- Filter compacts via a cumsum+scatter keepers-first permutation (the
+  reference uses ``thrust::copy_if``, ``filter_gpu.hpp:331-335``; no
+  sort on either side);
+- Reduce groups by key slot (the permutation comes precomputed from the
+  HOST key metadata — one sort of the raw keys; no device sort) and runs
+  a segmented associative scan with the user's combine, gathering
+  segment tails — one result per key per batch, exactly the reference
+  semantics (``reduce_gpu.hpp:239-272``: sort_by_key + reduce_by_key).
+  The combine must be associative and commutative (``API:78-80``);
 - stateful Map/Filter keep per-key state in a device-resident table
   (slots × state pytree) updated by a masked ``lax.scan`` in arrival order —
   replacing the reference's per-key CUDA state objects + cross-replica
@@ -127,12 +129,11 @@ class TPUReplicaBase(BasicReplica):
             keys = key_column_to_list(batch, field)
         return keys
 
-    def batch_slots(self, batch: BatchTPU):
-        """Per-batch dense slot ids + slot->key order. Device ops run in
-        DEFAULT mode only, so intra-batch output order is free: int keys
-        take a vectorized unique (slot order = sorted keys), others keep
-        first-appearance order via the Python loop."""
-        import jax
+    def batch_slots_np(self, batch: BatchTPU):
+        """Per-batch dense slot ids (HOST numpy) + slot->key order. Device
+        ops run in DEFAULT mode only, so intra-batch output order is free:
+        int keys take a vectorized unique (slot order = sorted keys),
+        others keep first-appearance order via the Python loop."""
         keys = self.batch_keys(batch)
         n = batch.size
         keys_arr = np.asarray(keys)
@@ -142,13 +143,13 @@ class TPUReplicaBase(BasicReplica):
             slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
             slots[:n] = inv
             slot_of_key = {int(k): i for i, k in enumerate(uniq)}
-            return jax.device_put(slots), slot_of_key
+            return slots, slot_of_key
         slot_of_key: Dict[Any, int] = {}
         slots = np.zeros(batch.capacity, dtype=np.int32)
         for i, k in enumerate(keys):
             slots[i] = slot_of_key.setdefault(k, len(slot_of_key))
         slots[n:] = len(slot_of_key)  # padding segment
-        return jax.device_put(slots), slot_of_key
+        return slots, slot_of_key
 
 
 class TPUOperatorBase(BasicOperator):
@@ -561,10 +562,11 @@ class ReduceTPUReplica(TPUReplicaBase):
 
         combine = op.combine
 
-        def run(fields, slots):
-            order = jnp.argsort(slots, stable=True)
+        def run(fields, order, s):
+            # order/s precomputed on HOST from the key metadata (already
+            # touched for slot mapping; radix argsort of small ids) — no
+            # device sort at all
             f = {k: v[order] for k, v in fields.items()}
-            s = slots[order]
 
             def seg_op(a, b):
                 fa, sa = a
@@ -585,10 +587,39 @@ class ReduceTPUReplica(TPUReplicaBase):
 
         self._jitted = jax.jit(run)
 
+    def _order_and_slots(self, batch: BatchTPU):
+        """(order, sorted slot ids, slot->key map) with ONE sort: int
+        keys sort directly (group boundaries give the sorted slot ids);
+        other keys go through the generic slot map + a radix argsort of
+        the small dense ids."""
+        from .keymap import stable_group_argsort
+
+        n = batch.size
+        cap = batch.capacity
+        keys_arr = np.asarray(self.batch_keys(batch))
+        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
+            order_n = np.argsort(keys_arr[:n], kind="stable")
+            sk = keys_arr[:n][order_n]
+            new_grp = np.r_[True, sk[1:] != sk[:-1]]
+            uniq = sk[new_grp]
+            slot_of_key = {int(k): i for i, k in enumerate(uniq)}
+            order = np.empty(cap, dtype=np.int32)
+            order[:n] = order_n
+            order[n:] = np.arange(n, cap)
+            ssorted = np.full(cap, len(uniq), dtype=np.int32)
+            ssorted[:n] = np.cumsum(new_grp) - 1
+            return order, ssorted, slot_of_key
+        slots_np, slot_of_key = self.batch_slots_np(batch)
+        order = stable_group_argsort(
+            slots_np, len(slot_of_key) + 1).astype(np.int32)
+        return order, slots_np[order], slot_of_key
+
     def process_device_batch(self, batch: BatchTPU) -> None:
         import jax
-        slots_dev, slot_of_key = self.batch_slots(batch)
-        out_fields = self._jitted(batch.fields, slots_dev)
+
+        order_np, ssorted, slot_of_key = self._order_and_slots(batch)
+        out_fields = self._jitted(batch.fields, jax.device_put(order_np),
+                                  jax.device_put(ssorted))
         self.stats.device_programs_run += 1
         n_out = len(slot_of_key)
         if n_out == 0:
