@@ -357,3 +357,32 @@ def test_ffat_tpu_tuple_keys():
     for w in range(N - 3):
         expect = 3 * sum(p + 1 for p in range(w, w + 4))
         assert res.get((w,)) == expect, (w, res.get((w,)), expect)
+
+
+def test_ffat_tpu_gap_windows_late_first_key_reanchor():
+    """Regression (round-2 review): with GAP windows (slide > win) a key's
+    FIRST tuple can land in a gap and stay late, leaving the slot
+    unanchored (max_leaf < 0) past its registration batch. A much later
+    timestamp must then RE-anchor the window origin instead of growing
+    the pane ring toward epoch scale (which overflows the int32 index
+    plane and raises)."""
+    coll = DictWinCollector()
+    graph = PipeGraph("gap", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        shipper.push_with_timestamp(TupleT(0, 7, 5000), 5000)  # in a gap
+        shipper.set_next_watermark(5000)
+        ts2 = 300_000_000_005  # ~epoch-scale jump, separate batch
+        shipper.push_with_timestamp(TupleT(0, 9, ts2), ts2)
+        shipper.set_next_watermark(ts2)
+
+    src_op = Source_Builder(src).with_output_batch_size(1).build()
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b_: {"value": a["value"] + b_["value"]})
+          .with_key_by("key").with_tb_windows(1000, 10000).build())
+    graph.add_source(src_op).add(op).add_sink(
+        Sink_Builder(coll.sink).build())
+    graph.run()
+    # window 30_000_000 covers panes [3e8, 3e8+1); the gap tuple is late
+    assert coll.results.get((0, 30_000_000)) == 9

@@ -135,6 +135,17 @@ class FfatTPUReplica(TPUReplicaBase):
         # _out_keys_by_slot python list for non-int keys)
         self._keys_np = np.zeros(self.K_cap, dtype=np.int64)
         self._keys_all_int = True
+        self._key_dtype = np.dtype(np.int32)
+        self._saw_new_key = False
+        self._leaf_frontier = 0  # max leaf ever accepted (fast-path guard)
+        # device-resident constant program args (avoid re-transferring
+        # numpy zeros/dummies every batch on a tunneled device)
+        self._zero_fire_cache: Dict[int, Any] = {}
+        self._seg_dummy = None
+        # device-resident per-slot key table (lazy; see _ktable_arg)
+        self._ktable_dev = None
+        self._ktable_kd = None
+        self._ktable_dirty = True
         self.ignored = 0
         # device forest (lazily shaped once the lift output is known)
         self.trees = None  # dict field -> (K_cap, 2F)
@@ -142,6 +153,13 @@ class FfatTPUReplica(TPUReplicaBase):
         self._prog_cache = op._prog_cache  # shared across replicas
         self.__host_seg = None  # resolved lazily: backend init is costly
         self._check_index_plane()
+
+    def _comp_dtype(self):
+        """(sentinel M, dtype) of the packed composite — the SINGLE
+        definition shared by staging, dummies, and the driver entry
+        (the traced and runtime dtypes must stay bit-identical)."""
+        M = self.K_cap * self.F
+        return M, (np.int16 if M < 2**15 - 1 else np.int32)
 
     def _check_index_plane(self) -> None:
         """Every forest index (host composite sort, device scatter/evict
@@ -236,6 +254,7 @@ class FfatTPUReplica(TPUReplicaBase):
         import jax.numpy as jnp
 
         host_seg = self._host_seg
+        use_ktable = self._use_ktable()
 
         lift = self.op.lift
         combine = self.op.combine
@@ -257,16 +276,22 @@ class FfatTPUReplica(TPUReplicaBase):
                 combine, list(self.trees.keys()), F,
                 interpret=jax.default_backend() != "tpu")
 
-        def step(fields, slots, leaves_phys, live, h_order, h_same, h_end,
+        def step(fields, comp, h_order, h_same, h_end,
                  h_flat, trees, tvalid,
-                 fire_slots, fire_starts, fire_lens, fire_mask,
-                 evict_slots, evict_leaves, evict_mask):
+                 fire_pack, fire_mask, ktable,
+                 evict_pack, evict_mask):
+            fire_slots, fire_starts, fire_lens, fire_wids = fire_pack
+            evict_slots, evict_leaves = evict_pack
             # 1. lift + sort + segmented scan. WHERE the sort happens is
             # backend-dependent: on accelerators it runs in-program (device
             # work overlaps the host control plane); on the CPU backend the
-            # program shares cores with the host, so numpy precomputes the
-            # order/run metadata (h_* args; the device-mode args are dummies
-            # then, and vice versa — the cache key includes the mode).
+            # host precomputes the order/run metadata with numpy (h_* args;
+            # ``comp`` is a dummy then, and vice versa — the cache key
+            # includes the mode). In device mode the host ships ONE packed
+            # composite array (slot*F+leaf, sentinel K_cap*F for late and
+            # padding lanes) in the narrowest int dtype — a third of the
+            # transfer volume of separate slot/leaf/live arrays, which
+            # matters when the chip sits behind a network tunnel.
             vals = lift(fields)
             if host_seg:
                 order = h_order
@@ -275,14 +300,15 @@ class FfatTPUReplica(TPUReplicaBase):
                 flat_idx = h_flat
             else:
                 big = jnp.int32(K_cap * F)  # sentinel: late + padding
-                composite = jnp.where(live, slots * F + leaves_phys, big)
-                order = jnp.argsort(composite, stable=True)
-                sc = composite[order]
+                order = jnp.argsort(comp, stable=True)
+                sc = comp[order].astype(jnp.int32)
                 same_prev = jnp.concatenate(
                     [jnp.zeros((1,), bool), sc[1:] == sc[:-1]])
                 is_end = jnp.concatenate(
                     [sc[1:] != sc[:-1], jnp.ones((1,), bool)]) & (sc < big)
-                flat_idx = slots[order] * NNODES + (F + leaves_phys[order])
+                # decode slot/leaf from the sorted composite (F is a power
+                # of two, so these lower to shift/mask)
+                flat_idx = (sc // F) * NNODES + (F + sc % F)
             svals = tmap(lambda a: a[order], vals)
 
             def seg_op(a, b):
@@ -340,7 +366,16 @@ class FfatTPUReplica(TPUReplicaBase):
             tvalid = tvalid.reshape(-1).at[eflat].set(
                 False, mode="drop").reshape(tvalid.shape)
 
-            return trees, tvalid, qr, qv
+            # 6. output wid/key columns built ON DEVICE: they ride the
+            # program's batched argument transfer instead of costing one
+            # device_put round trip each at emit time
+            wid_out = jnp.asarray(fire_wids)
+            if use_ktable:
+                key_out = jnp.where(fire_mask, ktable[fire_slots],
+                                    jnp.zeros((), ktable.dtype))
+            else:
+                key_out = jnp.zeros((1,), jnp.int32)
+            return trees, tvalid, qr, qv, wid_out, key_out
 
         return jax.jit(step)
 
@@ -366,9 +401,12 @@ class FfatTPUReplica(TPUReplicaBase):
         OOB = self.K_cap * NNODES
         tmap = jax.tree_util.tree_map
         _, window_query = self._query_fns()
+        use_ktable = self._use_ktable()
 
-        def fire(trees, tvalid, fire_slots, fire_starts, fire_lens,
-                 fire_mask, evict_slots, evict_leaves, evict_mask):
+        def fire(trees, tvalid, fire_pack, fire_mask, ktable,
+                 evict_pack, evict_mask):
+            fire_slots, fire_starts, fire_lens, fire_wids = fire_pack
+            evict_slots, evict_leaves = evict_pack
             ftrees = tmap(lambda t: t[fire_slots], trees)
             fvalid = tvalid[fire_slots]
             qv, qr = jax.vmap(window_query)(ftrees, fvalid, fire_starts,
@@ -378,7 +416,13 @@ class FfatTPUReplica(TPUReplicaBase):
                               evict_slots * NNODES + (F + evict_leaves), OOB)
             tvalid = tvalid.reshape(-1).at[eflat].set(
                 False, mode="drop").reshape(tvalid.shape)
-            return tvalid, qr, qv
+            wid_out = jnp.asarray(fire_wids)
+            if use_ktable:
+                key_out = jnp.where(fire_mask, ktable[fire_slots],
+                                    jnp.zeros((), ktable.dtype))
+            else:
+                key_out = jnp.zeros((1,), jnp.int32)
+            return tvalid, qr, qv, wid_out, key_out
 
         return jax.jit(fire)
 
@@ -387,6 +431,7 @@ class FfatTPUReplica(TPUReplicaBase):
     # ==================================================================
     def _on_new_key(self, key, s: int) -> None:
         """KeySlotMap callback: per-slot bookkeeping for a fresh key."""
+        self._saw_new_key = True
         self._out_keys_by_slot.append(key)
         if s >= self.K_cap:
             self._grow_keys()
@@ -394,6 +439,7 @@ class FfatTPUReplica(TPUReplicaBase):
             self._keys_np[s] = key
         else:
             self._keys_all_int = False
+        self._ktable_dirty = True
 
     def _slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
         return self._keymap.slots_of(keys, keys_arr, n)
@@ -416,6 +462,7 @@ class FfatTPUReplica(TPUReplicaBase):
                 .at[:old].set(t), self.trees)
             self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool
                                     ).at[:old].set(self.tvalid)
+        self._ktable_dirty = True
         self._check_index_plane()
 
     def _grow_ring(self, needed_span: int) -> None:
@@ -481,8 +528,19 @@ class FfatTPUReplica(TPUReplicaBase):
             np.add.at(self.count, slots, 1)
         # align brand-new keys to the first window containing their first
         # leaf: without this, an epoch-scale first timestamp would demand a
-        # ring spanning all of absolute time (OOM via _grow_ring)
-        if op.win_type is WinType.TB:
+        # ring spanning all of absolute time (OOM via _grow_ring).
+        # Gated on _saw_new_key when slide <= win: then registration sets
+        # next_fire at or below the registering tuple's leaf (w0*slide <=
+        # first_leaf - win + slide <= first_leaf), so that tuple is live
+        # and max_leaf goes >= 0 in the same batch — a slot can only be
+        # "fresh" (max_leaf<0) in its registration batch and steady state
+        # skips the 16k-gather entirely. With GAP windows (slide > win)
+        # the registering tuple can land in a gap and stay late, so the
+        # alignment must re-run every batch (pre-gate behavior; regression
+        # test: gap_windows_late_first_key_reanchor).
+        if op.win_type is WinType.TB and (
+                self._saw_new_key or self.slide_units > self.win_units):
+            self._saw_new_key = False
             fresh = self.max_leaf[slots] < 0
             if fresh.any():
                 fslots = slots[fresh]
@@ -498,54 +556,69 @@ class FfatTPUReplica(TPUReplicaBase):
                     // self.slide_units + 1)
                 self.next_fire[sel] = w0 * self.slide_units
                 self.fired[sel] = w0
-        live = leaves >= self.next_fire[slots]
-        n_late = int(n - live.sum())
+        nf = self.next_fire[slots]
+        live = leaves >= nf
+        n_live = int(live.sum())
+        n_late = n - n_live
         if n_late:
             self.ignored += n_late
             self.stats.inputs_ignored += n_late
-        if live.any():
-            span = int((leaves[live] - self.next_fire[slots[live]]).max())
-            if span >= self.F:
-                self._grow_ring(span)
-            lv_slots = slots[live]
-            np.maximum.at(self.max_leaf, lv_slots, leaves[live])
+        if n_live:
+            if (n_late == 0 and n and int(leaves[0]) >= self._leaf_frontier
+                    and bool((leaves[1:] >= leaves[:-1]).all())):
+                # monotone event time at or past every previously seen
+                # leaf (the common in-order source pattern): the last
+                # occurrence per slot carries its max leaf AND cannot
+                # undercut an older per-slot max, so a plain fancy
+                # assignment (last-write-wins for duplicate indices,
+                # np.put semantics) replaces the much slower
+                # np.maximum.at buffered scatter
+                span = int((leaves - nf).max())
+                if span >= self.F:
+                    self._grow_ring(span)
+                self.max_leaf[slots] = leaves
+                self._leaf_frontier = int(leaves[-1])
+            else:
+                # masked forms avoid boolean fancy-index allocations; the
+                # -1 sentinel is a no-op under maximum (max_leaf starts
+                # at -1)
+                masked_leaves = np.where(live, leaves, -1)
+                span = int(np.where(live, leaves - nf, -1).max())
+                if span >= self.F:
+                    self._grow_ring(span)
+                np.maximum.at(self.max_leaf, slots, masked_leaves)
+                self._leaf_frontier = max(self._leaf_frontier,
+                                          int(masked_leaves.max()))
 
         cap = batch.capacity
-        slots_p = np.zeros(cap, dtype=np.int32)
-        slots_p[:n] = slots
-        leafphys_p = np.zeros(cap, dtype=np.int32)
-        leafphys_p[:n] = leaves % self.F
-        live_p = np.zeros(cap, dtype=bool)
-        live_p[:n] = live
+        # packed composite (slot*F + leaf, sentinel M = late/padding) in
+        # the narrowest int dtype: ONE array instead of separate
+        # slot/leaf/live planes — numpy's argsort takes a radix path for
+        # int16 (~12x the int64 comparison sort) on the host-seg branch,
+        # and in device mode it is the only 16k-sized program argument
+        # (a third of the previous H2D volume; int32 is guaranteed by
+        # _check_index_plane at init/growth for BOTH seg modes).
+        M, cdt = self._comp_dtype()
+        comp_p = np.full(cap, M, dtype=cdt)
+        packed = slots * self.F + (leaves & (self.F - 1))  # F is pow-2
+        if n_late:
+            packed = np.where(live, packed, M)
+        comp_p[:n] = packed
         if self._host_seg:
-            # The stable composite sort is the host hot spot. numpy's
-            # argsort takes a radix path for int16 (~12x the int64
-            # comparison sort), so use the narrowest dtype that holds
-            # K_cap*F (+1 for the sentinel); int32 is guaranteed by
-            # _check_index_plane at init/growth for BOTH seg modes.
-            M = self.K_cap * self.F
-            cdt = np.int16 if M < 2**15 - 1 else np.int32
             big = cdt(M)
-            composite = np.where(live_p,
-                                 slots_p.astype(cdt) * cdt(self.F)
-                                 + leafphys_p.astype(cdt), big)
-            order_p = np.argsort(composite, kind="stable").astype(np.int32)
-            sc = composite[order_p]
+            order_p = np.argsort(comp_p, kind="stable").astype(np.int32)
+            sc = comp_p[order_p].astype(np.int32)
             same_p = np.r_[False, sc[1:] == sc[:-1]]
             end_p = np.r_[sc[1:] != sc[:-1], True] & (sc < big)
-            flat_p = (slots_p[order_p].astype(np.int32) * (2 * self.F)
-                      + self.F + leafphys_p[order_p])
-            # device-mode inputs shrink to dummies in host mode
-            slots_p = np.zeros(1, dtype=np.int32)
-            leafphys_p = np.zeros(1, dtype=np.int32)
-            live_p = np.zeros(1, dtype=bool)
+            flat_p = (sc // self.F) * (2 * self.F) + self.F + sc % self.F
+            comp_p = np.zeros(1, dtype=cdt)  # device arg shrinks to dummy
         else:
             order_p = same_p = end_p = flat_p = None
 
         frontier = (max(0, batch.wm - op.lateness) // op.pane_len
                     if op.win_type is WinType.TB else None)
-        self._run_step(batch.fields, batch.wm, cap, slots_p, leafphys_p,
-                       live_p, order_p, same_p, end_p, flat_p, frontier)
+        self._run_step(batch.fields, batch.wm, cap, comp_p,
+                       order_p, same_p, end_p, flat_p, frontier)
 
     # ------------------------------------------------------------------
     def _fireable(self, frontier, partial: bool, budget: int):
@@ -603,20 +676,20 @@ class FfatTPUReplica(TPUReplicaBase):
         """Chunk arrays -> padded fire/evict arrays for the device
         programs (shaped for budget ``W``; jit re-traces per shape). Pure
         numpy (repeat + segmented arange): zero per-window or per-chunk
-        Python."""
+        Python. Fire metadata is PACKED into one (4, W) int32 array
+        (rows: slot, start, len, wid) and evictions into one (2, E)
+        (rows: slot, leaf) — fewer program arguments means fewer per-call
+        transfer enqueues on a tunneled device."""
         c_slots, c_start0, c_k, c_wid0, c_ml = chunks
         E = max(1, W * self.slide_units)
-        f_slots = np.zeros(W, dtype=np.int32)
-        f_starts = np.zeros(W, dtype=np.int32)
-        f_lens = np.zeros(W, dtype=np.int32)
+        f_pack = np.zeros((4, W), dtype=np.int32)
         f_mask = np.zeros(W, dtype=bool)
-        e_slots = np.zeros(E, dtype=np.int32)
-        e_leaves = np.zeros(E, dtype=np.int32)
+        e_pack = np.zeros((2, E), dtype=np.int32)
         e_mask = np.zeros(E, dtype=bool)
         ar = self._segmented_arange(c_k)
         starts = np.repeat(c_start0, c_k) + ar * self.slide_units
-        f_slots[:n_out] = np.repeat(c_slots, c_k)
-        f_starts[:n_out] = starts % self.F
+        f_pack[0, :n_out] = np.repeat(c_slots, c_k)
+        f_pack[1, :n_out] = starts % self.F
         # ALWAYS clip the query to the slot's data extent (max_leaf):
         # panes beyond it hold no current data, and their ring slots may
         # alias panes evicted after the last level rebuild — clipping is
@@ -625,10 +698,10 @@ class FfatTPUReplica(TPUReplicaBase):
         # untouched by this drain sequence's evictions; aliases land at
         # pane+F > max_leaf, which is excluded here, and _grow_ring
         # guarantees live spans stay below F)
-        f_lens[:n_out] = np.minimum(self.win_units,
-                                    np.repeat(c_ml, c_k) + 1 - starts)
+        f_pack[2, :n_out] = np.minimum(self.win_units,
+                                       np.repeat(c_ml, c_k) + 1 - starts)
         f_mask[:n_out] = True
-        wids = np.repeat(c_wid0, c_k) + ar
+        f_pack[3, :n_out] = np.repeat(c_wid0, c_k) + ar
         # evicted panes: one contiguous range per chunk
         ne = np.maximum(
             0, np.minimum(c_start0 + c_k * self.slide_units, c_ml + 1)
@@ -636,16 +709,51 @@ class FfatTPUReplica(TPUReplicaBase):
         tot_e = int(ne.sum())
         if tot_e:
             ep = np.repeat(c_start0, ne) + self._segmented_arange(ne)
-            e_slots[:tot_e] = np.repeat(c_slots, ne)
-            e_leaves[:tot_e] = ep % self.F
+            e_pack[0, :tot_e] = np.repeat(c_slots, ne)
+            e_pack[1, :tot_e] = ep % self.F
             e_mask[:tot_e] = True
-        return (f_slots, f_starts, f_lens, f_mask, wids,
-                e_slots, e_leaves, e_mask)
+        return f_pack, f_mask, e_pack, e_mask
+
+    def _use_ktable(self) -> bool:
+        """Whether programs gather the output key column from a
+        device-resident per-slot key table (int keys with a named key
+        field; non-int keys fall back to host construction)."""
+        return self._keys_all_int and self.op.key_field is not None
+
+    def _ktable_arg(self):
+        """Device key table for the programs' key-column gather; re-staged
+        only when a new key registered or the capacity/dtype changed —
+        zero steady-state transfer."""
+        if not self._use_ktable():
+            return np.zeros(1, dtype=np.int32)
+        import jax
+        kd = self._key_dtype
+        if (self._ktable_dev is None or self._ktable_dirty
+                or self._ktable_kd != kd):
+            self._ktable_dev = jax.device_put(self._keys_np.astype(kd))
+            self._ktable_kd = kd
+            self._ktable_dirty = False
+        return self._ktable_dev
+
+    def _zero_fire(self, W: int):
+        """Device-resident all-zero fire/evict args for non-firing steps
+        (cached per budget: zero steady-state transfer)."""
+        z = self._zero_fire_cache.get(W)
+        if z is None:
+            import jax
+            E = max(1, W * self.slide_units)
+            z = self._zero_fire_cache[W] = (
+                jax.device_put(np.zeros((4, W), dtype=np.int32)),
+                jax.device_put(np.zeros(W, dtype=bool)),
+                jax.device_put(np.zeros((2, E), dtype=np.int32)),
+                jax.device_put(np.zeros(E, dtype=bool)))
+        return z
 
     def _fire_step(self):
         from .ops_tpu import cached_compile
         return cached_compile(self._prog_cache, self.op._prog_lock,
-                              ("fire", self.K_cap, self.F),
+                              ("fire", self.K_cap, self.F,
+                               self._use_ktable(), str(self._key_dtype)),
                               self._make_fire_step)
 
     def _warm_fire_step(self) -> None:
@@ -655,83 +763,76 @@ class FfatTPUReplica(TPUReplicaBase):
         path instead of startup."""
         if self.trees is None:
             return
-        if ("fire", self.K_cap, self.F) in self._prog_cache:
+        if ("fire", self.K_cap, self.F, self._use_ktable(),
+                str(self._key_dtype)) in self._prog_cache:
             return  # already compiled (e.g. a new batch-capacity bucket)
         W = self.W_cap
         E = max(1, W * self.slide_units)
-        z32 = np.zeros(W, dtype=np.int32)
-        self._fire_step()(self.trees, self.tvalid, z32, z32,
-                          np.zeros(W, dtype=np.int32),
+        self._fire_step()(self.trees, self.tvalid,
+                          np.zeros((4, W), dtype=np.int32),
                           np.zeros(W, dtype=bool),
-                          np.zeros(E, dtype=np.int32),
-                          np.zeros(E, dtype=np.int32),
+                          self._ktable_arg(),
+                          np.zeros((2, E), dtype=np.int32),
                           np.zeros(E, dtype=bool))
 
-    def _run_step(self, fields, wm, cap, slots_p, leafphys_p, live_p,
-                  order_p, same_p, end_p, flat_p, frontier,
-                  partial: bool = False) -> None:
-        if self._host_seg and order_p is None:
-            # data-less segments in host mode (shape-preserving dummies)
-            order_p = np.zeros(cap, dtype=np.int32)
-            same_p = np.zeros(cap, dtype=bool)
-            end_p = np.zeros(cap, dtype=bool)
-            flat_p = np.zeros(cap, dtype=np.int32)
-            slots_p = np.zeros(1, dtype=np.int32)
-            leafphys_p = np.zeros(1, dtype=np.int32)
-            live_p = np.zeros(1, dtype=bool)
-        elif order_p is None:
-            order_p = np.zeros(1, dtype=np.int32)
-            same_p = np.zeros(1, dtype=bool)
-            end_p = np.zeros(1, dtype=bool)
-            flat_p = np.zeros(1, dtype=np.int32)
+    def _run_step(self, fields, wm, cap, comp_p,
+                  order_p, same_p, end_p, flat_p, frontier) -> None:
+        if order_p is None:  # device mode: cached 1-elem dummies
+            if self._seg_dummy is None:
+                import jax
+                self._seg_dummy = tuple(jax.device_put(a) for a in (
+                    np.zeros(1, dtype=np.int32), np.zeros(1, dtype=bool),
+                    np.zeros(1, dtype=bool), np.zeros(1, dtype=np.int32)))
+            order_p, same_p, end_p, flat_p = self._seg_dummy
+        ktable = self._ktable_arg()
         first = True
         while True:
             budget = self.W_step if first else self.W_cap
-            chunks = self._fireable(frontier, partial, budget)
+            chunks = self._fireable(frontier, False, budget)
             n_out = int(chunks[2].sum())
             if not first and not n_out:
                 break
-            (f_slots, f_starts, f_lens, f_mask, wids,
-             e_slots, e_leaves, e_mask) = self._pack_fire_arrays(
-                chunks, n_out, budget)
+            if n_out:
+                f_pack, f_mask, e_pack, e_mask = self._pack_fire_arrays(
+                    chunks, n_out, budget)
+            else:  # no windows fired: constant device-resident zeros
+                f_pack, f_mask, e_pack, e_mask = self._zero_fire(budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
                 from .ops_tpu import cached_compile
-                ckey = ("step", cap, self.K_cap, self.F, self._host_seg)
+                ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
+                        self._use_ktable(), str(self._key_dtype))
                 fresh = ckey not in self._prog_cache
                 step = cached_compile(self._prog_cache, self.op._prog_lock,
                                       ckey, lambda: self._make_step(cap))
                 if fresh:
                     self._warm_fire_step()
-                self.trees, self.tvalid, qr, qv = step(
-                    fields, slots_p, leafphys_p, live_p, order_p, same_p,
+                (self.trees, self.tvalid, qr, qv, wid_dev,
+                 key_dev) = step(
+                    fields, comp_p, order_p, same_p,
                     end_p, flat_p, self.trees, self.tvalid,
-                    f_slots, f_starts, f_lens, f_mask,
-                    e_slots, e_leaves, e_mask)
+                    f_pack, f_mask, ktable, e_pack, e_mask)
             else:
                 # drain iterations: fire-only program (no rebuild)
-                self.tvalid, qr, qv = self._fire_step()(
+                self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
                     self.trees, self.tvalid,
-                    f_slots, f_starts, f_lens, f_mask,
-                    e_slots, e_leaves, e_mask)
+                    f_pack, f_mask, ktable, e_pack, e_mask)
             self.stats.device_programs_run += 1
             if n_out:
-                self._emit_windows(wm, chunks, n_out, wids, qr, qv, budget)
+                self._emit_windows(wm, chunks, n_out, qr, qv,
+                                   wid_dev, key_dev, budget)
             first = False
             if n_out < budget:
                 break
 
-    def _emit_windows(self, wm, chunks, n_out, wids, qr, qv,
-                      W: int) -> None:
+    def _emit_windows(self, wm, chunks, n_out, qr, qv,
+                      wid_dev, key_dev, W: int) -> None:
         import jax
 
         op = self.op
-        pad = W - n_out
         fields = dict(qr)
         fields["valid"] = qv
-        wid_col = np.zeros(W, dtype=np.int32)
-        wid_col[:n_out] = wids
-        fields["wid"] = jax.device_put(wid_col)
+        fields["wid"] = wid_dev  # built in-program: no device_put here
         c_slots, _st, c_k, _w0, _ml = chunks
         slot_per_win = np.repeat(c_slots, c_k)
         if self._keys_all_int:
@@ -743,12 +844,15 @@ class FfatTPUReplica(TPUReplicaBase):
             # tuples would be ragged)
             out_keys = [self._out_keys_by_slot[s] for s in slot_per_win]
         if op.key_field is not None:
-            # build directly in the key column's dtype (float keys must
-            # not round-trip through int64)
-            kd = getattr(self, "_key_dtype", np.dtype(np.int32))
-            key_col = np.zeros(W, dtype=kd)
-            key_col[:n_out] = out_keys
-            fields[op.key_field] = jax.device_put(key_col)
+            if self._use_ktable():
+                fields[op.key_field] = key_dev  # gathered in-program
+            else:
+                # build directly in the key column's dtype (float keys
+                # must not round-trip through int64)
+                kd = self._key_dtype
+                key_col = np.zeros(W, dtype=kd)
+                key_col[:n_out] = out_keys
+                fields[op.key_field] = jax.device_put(key_col)
         out_schema = TupleSchema(
             {name: np.dtype(v.dtype) for name, v in fields.items()})
         ts = np.full(W, wm, dtype=np.int64)
@@ -766,15 +870,14 @@ class FfatTPUReplica(TPUReplicaBase):
             n_out = int(chunks[2].sum())
             if not n_out:
                 return
-            (f_slots, f_starts, f_lens, f_mask, wids,
-             e_slots, e_leaves, e_mask) = self._pack_fire_arrays(
+            f_pack, f_mask, e_pack, e_mask = self._pack_fire_arrays(
                 chunks, n_out, self.W_cap)
-            self.tvalid, qr, qv = self._fire_step()(
-                self.trees, self.tvalid, f_slots, f_starts, f_lens, f_mask,
-                e_slots, e_leaves, e_mask)
+            self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
+                self.trees, self.tvalid, f_pack, f_mask,
+                self._ktable_arg(), e_pack, e_mask)
             self.stats.device_programs_run += 1
-            self._emit_windows(self.cur_wm, chunks, n_out, wids, qr, qv,
-                               self.W_cap)
+            self._emit_windows(self.cur_wm, chunks, n_out, qr, qv,
+                               wid_dev, key_dev, self.W_cap)
             if n_out < self.W_cap:
                 return
 

@@ -36,9 +36,11 @@ class KeySlotMap:
         return s
 
     def slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
-        """Vectorized mapping of a whole batch; int64 result of length n.
-        The int fast paths require a 1-D int array — tuple-of-int keys
-        become a 2-D array and must take the generic per-key path."""
+        """Vectorized mapping of a whole batch; int result of length n
+        (int32 on the LUT fast path — valid for indexing and promoted by
+        numpy in mixed arithmetic; avoids a 16k-copy per batch). The int
+        fast paths require a 1-D int array — tuple-of-int keys become a
+        2-D array and must take the generic per-key path."""
         if keys_arr.ndim != 1:
             return np.fromiter((self.slot(k) for k in keys),
                                dtype=np.int64, count=n)
@@ -60,7 +62,7 @@ class KeySlotMap:
                     for k in np.unique(keys_arr[miss]):
                         lut[k] = self.slot(int(k))
                     slots = lut[keys_arr]
-                return slots.astype(np.int64)
+                return slots
         if keys_arr.dtype.kind in "iu":
             uniq, inverse = np.unique(keys_arr, return_inverse=True)
             slot_map = np.fromiter((self.slot(int(k)) for k in uniq),
