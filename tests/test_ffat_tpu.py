@@ -386,3 +386,21 @@ def test_ffat_tpu_gap_windows_late_first_key_reanchor():
     graph.run()
     # window 30_000_000 covers panes [3e8, 3e8+1); the gap tuple is late
     assert coll.results.get((0, 30_000_000)) == 9
+
+
+@pytest.mark.parametrize("force_device_seg", [False, True])
+def test_ffat_tpu_adaptive_fire_tiers(force_device_seg, monkeypatch):
+    """Exercise the adaptive two-tier fire budget (W_cap > W_step): a
+    stream firing more than W_step windows per batch must switch to the
+    wide tier (device mode), warm both program shapes eagerly, and keep
+    exact window results on both tiers and both seg modes."""
+    if force_device_seg:
+        monkeypatch.setenv("WF_FORCE_DEVICE_SEG", "1")
+    n_keys, stream_len = 96, 60
+    expected = expected_windows(model_seqs(n_keys, stream_len), WIN_US,
+                                SLIDE_US, False, sum_or_none)
+    coll = run_ffat_tpu(WIN_US, SLIDE_US, win_type_cb=False,
+                        n_keys=n_keys, stream_len=stream_len,
+                        nwpb=256, obs=512)
+    assert coll.dups == 0
+    assert coll.results == expected

@@ -85,7 +85,7 @@ class Ffat_Windows_TPU(TPUOperatorBase):
             # capacity so high-cardinality streams don't drain through
             # many tiny programs (the reference leaves numWinPerBatch
             # manual, builders_gpu.hpp:576)
-            num_win_per_batch = max(16, min(4096, self.key_capacity))
+            num_win_per_batch = max(16, min(8192, self.key_capacity))
         self.num_win_per_batch = max(1, num_win_per_batch)
         self.pane_len = math.gcd(win_len, slide_len)
         # compiled programs shared ACROSS replicas: cache keys carry every
@@ -116,12 +116,19 @@ class FfatTPUReplica(TPUReplicaBase):
         # pre-sizing the key table avoids growth recompiles
         # (wf/builders_gpu.hpp has no analog; growth still works past it)
         self.K_cap = 1 << max(2, math.ceil(math.log2(op.key_capacity)))
-        # two-tier fire budget: the full per-batch program carries a SMALL
-        # window budget (most batches fire few windows; keeps the always-
-        # paid vmapped-query cost low), drain iterations and data-less
-        # firing use the full W_cap so backlogs clear in few programs
+        # two fire-budget tiers: W_step keeps the full per-batch
+        # program's vmapped-query block small, W_cap is the wide budget
+        # used by drain iterations and data-less firing so backlogs
+        # clear in few programs
         self.W_cap = op.num_win_per_batch
         self.W_step = min(self.W_cap, 64)
+        # adaptive two-tier first-iteration fire budget (device mode):
+        # an EWMA of fired-windows-per-batch picks W_step (small always-
+        # paid query block) or W_cap (high-cardinality streams fire in
+        # ONE program per batch); both shapes compile eagerly, see
+        # _first_budget. Starts at 0 so low-fire streams begin on the
+        # small tier.
+        self._fire_ewma = 0.0
         from .keymap import KeySlotMap
         self._keymap = KeySlotMap(on_new=self._on_new_key)
         self.slot_of_key = self._keymap.slot_of_key  # shared dict
@@ -735,6 +742,19 @@ class FfatTPUReplica(TPUReplicaBase):
             self._ktable_dirty = False
         return self._ktable_dev
 
+    def _first_budget(self) -> int:
+        """Fire budget for the first (full) program of a batch — one of
+        exactly TWO tiers (both compiled eagerly, so no mid-stream
+        retrace ever): the small W_step block, or W_cap when the recent
+        fire rate overflows it. Device mode only: the wide query block is
+        overlapped device work there and saves two host dispatches per
+        batch, while on the CPU backend the drain path's fire-only
+        program (no lift/sort/rebuild) is much cheaper than widening the
+        full program."""
+        if self._host_seg or self._fire_ewma * 1.25 <= self.W_step:
+            return self.W_step
+        return self.W_cap
+
     def _zero_fire(self, W: int):
         """Device-resident all-zero fire/evict args for non-firing steps
         (cached per budget: zero steady-state transfer)."""
@@ -786,8 +806,10 @@ class FfatTPUReplica(TPUReplicaBase):
             order_p, same_p, end_p, flat_p = self._seg_dummy
         ktable = self._ktable_arg()
         first = True
+        total_fired = 0
+        first_budget = self._first_budget()
         while True:
-            budget = self.W_step if first else self.W_cap
+            budget = first_budget if first else self.W_cap
             chunks = self._fireable(frontier, False, budget)
             n_out = int(chunks[2].sum())
             if not first and not n_out:
@@ -807,6 +829,20 @@ class FfatTPUReplica(TPUReplicaBase):
                                       ckey, lambda: self._make_step(cap))
                 if fresh:
                     self._warm_fire_step()
+                    if not self._host_seg and self.W_cap != self.W_step:
+                        # eagerly compile the OTHER tier's shape of the
+                        # full program (all-sentinel no-op run, outputs
+                        # discarded; the real call below traces this
+                        # batch's tier): tier switches must never pay a
+                        # mid-stream compile
+                        other = (self.W_step if budget == self.W_cap
+                                 else self.W_cap)
+                        _M, cdt = self._comp_dtype()
+                        zf, zm, ze, zem = self._zero_fire(other)
+                        step(fields, np.full(cap, _M, dtype=cdt),
+                             order_p, same_p, end_p, flat_p,
+                             self.trees, self.tvalid,
+                             zf, zm, ktable, ze, zem)
                 (self.trees, self.tvalid, qr, qv, wid_dev,
                  key_dev) = step(
                     fields, comp_p, order_p, same_p,
@@ -821,9 +857,17 @@ class FfatTPUReplica(TPUReplicaBase):
             if n_out:
                 self._emit_windows(wm, chunks, n_out, qr, qv,
                                    wid_dev, key_dev, budget)
+            total_fired += n_out
             first = False
             if n_out < budget:
                 break
+        # fast-rise / slow-decay: a burst switches to the wide tier on
+        # the very next batch (both tier shapes are already compiled),
+        # while decay back to the small tier is smoothed
+        if total_fired > self._fire_ewma:
+            self._fire_ewma = float(total_fired)
+        else:
+            self._fire_ewma += 0.25 * (total_fired - self._fire_ewma)
 
     def _emit_windows(self, wm, chunks, n_out, qr, qv,
                       wid_dev, key_dev, W: int) -> None:
