@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Characterize the tunnel's D2H path: fixed latency, async overlap,
+batching across buffers, and bandwidth. Run as the only tunnel client."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bump(v):
+    return v + 1
+
+
+def fresh(n):
+    """A device buffer no host copy exists for."""
+    return jax.block_until_ready(bump(jnp.arange(n, dtype=jnp.int32)))
+
+
+def main() -> None:
+    print(f"platform={jax.devices()[0].platform}")
+
+    # 1. fetch AFTER block_until_ready (transfer cost only)
+    for n in (4, 16384, 1 << 22):
+        r = fresh(n)
+        t0 = time.perf_counter()
+        np.asarray(r)
+        dt = time.perf_counter() - t0
+        print(f"asarray fresh {n*4:>9}B after block: {dt*1e3:8.2f} ms")
+
+    # 2. async copy then fetch
+    r = fresh(16384)
+    r.copy_to_host_async()
+    t0 = time.perf_counter()
+    np.asarray(r)
+    print(f"asarray after copy_to_host_async (no wait): "
+          f"{(time.perf_counter()-t0)*1e3:8.2f} ms")
+    r = fresh(16384)
+    r.copy_to_host_async()
+    time.sleep(0.15)
+    t0 = time.perf_counter()
+    np.asarray(r)
+    print(f"asarray after copy_to_host_async + 150ms sleep: "
+          f"{(time.perf_counter()-t0)*1e3:8.2f} ms")
+
+    # 3. K fresh buffers fetched back-to-back: K*72ms or ~72ms total?
+    bufs = [fresh(16384) for _ in range(8)]
+    t0 = time.perf_counter()
+    for b in bufs:
+        np.asarray(b)
+    print(f"8 fresh buffers, serial asarray: "
+          f"{(time.perf_counter()-t0)*1e3:8.2f} ms total")
+
+    bufs = [fresh(16384) for _ in range(8)]
+    for b in bufs:
+        b.copy_to_host_async()
+    t0 = time.perf_counter()
+    for b in bufs:
+        np.asarray(b)
+    print(f"8 fresh buffers, async-all then asarray: "
+          f"{(time.perf_counter()-t0)*1e3:8.2f} ms total")
+
+    # 4. bandwidth on one big fresh buffer
+    r = fresh(1 << 24)  # 64 MiB
+    t0 = time.perf_counter()
+    np.asarray(r)
+    dt = time.perf_counter() - t0
+    print(f"64MiB fresh: {dt*1e3:8.2f} ms  "
+          f"({(1 << 26)/dt/1e9:.2f} GB/s)")
+
+    # 5. does jax.device_get on a LIST batch the transfers?
+    bufs = [fresh(16384) for _ in range(8)]
+    t0 = time.perf_counter()
+    jax.device_get(bufs)
+    print(f"device_get(list of 8 fresh): "
+          f"{(time.perf_counter()-t0)*1e3:8.2f} ms total")
+
+    # 6. isolated FFAT re-measure (bench config, 48 batches)
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    tps, wps, p99, progs = bench._run_config(bench.N_KEYS, 64, 48,
+                                             lat_batches=0)
+    print(f"FFAT 64keys isolated: {tps/1e6:.1f}M t/s, {wps:,.0f} win/s, "
+          f"{progs} programs")
+
+
+if __name__ == "__main__":
+    main()

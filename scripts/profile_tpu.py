@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Profile the tunneled-TPU execution path: dispatch RTT, pipelined
+dispatch rate, transfer costs, and the FFAT per-batch host/device split.
+
+Run as the ONLY tunnel client. Prints a labeled breakdown; no JSON.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, n=20, sync=lambda r: jax.block_until_ready(r)):
+    """Average seconds per call, syncing INSIDE the loop: each iteration
+    pays the full dispatch+execute+ready round-trip."""
+    sync(fn())  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        sync(fn())
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f"platform={dev.platform} device={dev}")
+
+    x = jnp.arange(16384, dtype=jnp.int32)
+
+    @jax.jit
+    def trivial(v):
+        return v + 1
+
+    # per-dispatch blocking RTT
+    t = timeit(lambda: trivial(x), n=50)
+    print(f"trivial jit, block each call:  {t*1e3:8.3f} ms/call")
+
+    # pipelined: chain 50 dispatches, block once
+    def chain():
+        v = x
+        for _ in range(50):
+            v = trivial(v)
+        return v
+    trivial(x)
+    t0 = time.perf_counter()
+    jax.block_until_ready(chain())
+    t = (time.perf_counter() - t0) / 50
+    print(f"trivial jit, pipelined x50:    {t*1e3:8.3f} ms/call")
+
+    # device_put of a 16k int32 column
+    h = np.arange(16384, dtype=np.int32)
+    t = timeit(lambda: jax.device_put(h), n=50)
+    print(f"device_put 64KiB:              {t*1e3:8.3f} ms/call")
+    h2 = np.arange(16384 * 16, dtype=np.int32)
+    t = timeit(lambda: jax.device_put(h2), n=20)
+    print(f"device_put 1MiB:               {t*1e3:8.3f} ms/call")
+
+    # small D2H readback
+    s = trivial(x)
+    t = timeit(lambda: np.asarray(s[:4]), n=50, sync=lambda r: None)
+    print(f"D2H 16B readback:              {t*1e3:8.3f} ms/call")
+
+    # a heavier program: segmented scan over 16k rows (FFAT-ish work)
+    @jax.jit
+    def seg(v):
+        return jnp.cumsum(v) + jnp.sort(v)
+
+    t = timeit(lambda: seg(x), n=30)
+    print(f"cumsum+sort 16k, block each:   {t*1e3:8.3f} ms/call")
+
+    # D2H size sweep: is the 16B readback latency fixed-cost?
+    big = jax.block_until_ready(trivial(jnp.arange(1 << 20, dtype=jnp.int32)))
+    for n in (16384, 1 << 20):
+        t = timeit(lambda: np.asarray(big[:n]), n=5, sync=lambda r: None)
+        print(f"D2H {n*4//1024}KiB readback:      {t*1e3:8.3f} ms/call")
+    t = timeit(lambda: jax.device_get(s), n=5, sync=lambda r: None)
+    print(f"device_get 64KiB whole array:  {t*1e3:8.3f} ms/call")
+    t = timeit(lambda: float(jnp.sum(s)), n=5, sync=lambda r: None)
+    print(f"scalar float() readback:       {t*1e3:8.3f} ms/call")
+
+    # ---- FFAT per-batch split --------------------------------------
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    rep = bench._make_replica(bench.N_KEYS, 64)
+
+    class Sink:
+        windows = 0
+        last_batch = None
+
+        def emit_device_batch(self, b):
+            self.windows += b.size
+            self.last_batch = b
+
+        def set_stats(self, s):
+            pass
+
+        def propagate_punctuation(self, wm):
+            pass
+
+        def flush(self):
+            pass
+
+    sink = Sink()
+    rep.emitter = sink
+    batches = bench._stage_batches(bench.N_KEYS, 40, 0, with_ts=True)
+    for b in batches[:4]:
+        rep.handle_msg(0, b)
+    jax.block_until_ready(rep.trees)
+
+    # (a) full path, pipelined (bench's throughput mode)
+    t0 = time.perf_counter()
+    for b in batches[4:]:
+        rep.handle_msg(0, b)
+    jax.block_until_ready(rep.trees)
+    full = (time.perf_counter() - t0) / 36
+    per_batch = batches[0].size
+    print(f"FFAT handle_msg, pipelined:    {full*1e3:8.3f} ms/batch "
+          f"({per_batch/full/1e6:.1f}M t/s)")
+
+    # (b) host-only: control plane with the device call stubbed out
+    import cProfile
+    import pstats
+
+    rep2 = bench._make_replica(bench.N_KEYS, 64)
+    sink2 = Sink()
+    rep2.emitter = sink2
+    b2 = bench._stage_batches(bench.N_KEYS, 40, 0, with_ts=True)
+    for b in b2[:4]:
+        rep2.handle_msg(0, b)
+    jax.block_until_ready(rep2.trees)
+    pr = cProfile.Profile()
+    pr.enable()
+    for b in b2[4:]:
+        rep2.handle_msg(0, b)
+    pr.disable()
+    jax.block_until_ready(rep2.trees)
+    st = pstats.Stats(pr)
+    st.sort_stats("cumulative")
+    print("\ntop cumulative (host-side) during 36 FFAT batches:")
+    st.print_stats(18)
+
+
+if __name__ == "__main__":
+    main()

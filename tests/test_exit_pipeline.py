@@ -1,0 +1,213 @@
+"""Pipelined D2H on the device-plane edges (TPUExitEmitter /
+TPUSplittingEmitter FIFOs): ordering and drain semantics. On the tunneled
+TPU a synchronous fetch of a fresh device buffer costs ~70 ms fixed, so
+both emitters hold a small FIFO of batches with async host copies in
+flight; these tests pin down when the FIFO MUST drain (single-row emits,
+punctuations, flush/EOS) so rows never reorder and watermarks stay
+monotone."""
+
+import numpy as np
+
+from windflow_tpu.basic import ExecutionMode
+from windflow_tpu.tpu.batch import BatchTPU
+from windflow_tpu.tpu.schema import TupleSchema
+
+
+class RecordingInner:
+    """Stands in for the wrapped CPU emitter."""
+
+    def __init__(self):
+        self.events = []
+        self.num_dests = 1
+        self.output_batch_size = 0
+        self.execution_mode = ExecutionMode.DEFAULT
+        self.stats = None
+        self.ports = []
+
+    def emit(self, payload, ts, wm, msg_id=None):
+        self.events.append(("row", payload["v"], wm))
+
+    def propagate_punctuation(self, wm):
+        self.events.append(("punct", wm))
+
+    def flush(self):
+        self.events.append(("flush",))
+
+    def send_eos_all(self):
+        self.events.append(("eos",))
+
+    def eos_ports(self):
+        return []
+
+    def set_ports(self, ports):
+        self.ports = ports
+
+
+def _batch(v0: int, n: int = 4, wm: int = 0) -> BatchTPU:
+    import jax
+
+    schema = TupleSchema({"v": np.int32})
+    vals = np.arange(v0, v0 + n, dtype=np.int32)
+    return BatchTPU({"v": jax.device_put(vals)},
+                    np.arange(n, dtype=np.int64), n, schema, wm=wm)
+
+
+def test_exit_fifo_defers_then_preserves_order():
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+
+    inner = RecordingInner()
+    em = TPUExitEmitter(inner, depth=2)
+    em.emit_device_batch(_batch(0, wm=1))
+    em.emit_device_batch(_batch(10, wm=2))
+    assert inner.events == []  # both parked in the FIFO
+    em.emit_device_batch(_batch(20, wm=3))  # pushes the first one out
+    assert [e[1] for e in inner.events] == [0, 1, 2, 3]
+    em.flush()
+    rows = [e[1] for e in inner.events if e[0] == "row"]
+    assert rows == [0, 1, 2, 3, 10, 11, 12, 13, 20, 21, 22, 23]
+
+
+def test_exit_single_row_and_punctuation_drain_first():
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+
+    inner = RecordingInner()
+    em = TPUExitEmitter(inner, depth=4)
+    em.emit_device_batch(_batch(0, n=2, wm=5))
+    # a punctuation must not overtake rows carrying older watermarks
+    em.propagate_punctuation(7)
+    assert inner.events == [("row", 0, 5), ("row", 1, 5), ("punct", 7)]
+    em.emit_device_batch(_batch(10, n=2, wm=8))
+    em.emit({"v": 99}, ts=0, wm=9)  # single-row emit drains queued batches
+    assert [e[1] for e in inner.events][-3:] == [10, 11, 99]
+    em.send_eos_all()
+    assert inner.events[-1] == ("eos",)
+
+
+def test_exit_fifo_idle_tick_delivers():
+    """The worker's idle tick (on_idle) must flush queued batches so an
+    idle stream never withholds already-computed results."""
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+
+    inner = RecordingInner()
+    em = TPUExitEmitter(inner, depth=4)
+    em.emit_device_batch(_batch(0, n=2))
+    assert inner.events == []
+    em.on_idle()
+    assert [e[1] for e in inner.events] == [0, 1]
+
+
+def test_channel_get_timeout_idle():
+    from windflow_tpu.runtime.channel import Channel
+
+    ch = Channel()
+    ch.register_input()
+    assert ch.get(timeout=0.05) is None  # empty channel: idle tick
+    ch.put(0, "x")
+    assert ch.get(timeout=0.05) == (0, "x")
+
+
+def test_worker_idle_tick_drains_exit_fifo():
+    """End-to-end: a TPU stage feeding a CPU sink delivers its rows while
+    the stream is idle (before any EOS), via the worker idle tick."""
+    import time
+
+    from windflow_tpu.runtime.channel import Channel, QueuePort
+    from windflow_tpu.runtime.worker import Worker
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+
+    inner = RecordingInner()
+
+    class PassThrough:
+        """Minimal replica: forwards device batches to its emitter."""
+
+        def __init__(self, emitter):
+            self.emitter = emitter
+
+        def handle_msg(self, ch, msg):
+            self.emitter.emit_device_batch(msg)
+
+        def terminate(self):
+            self.emitter.flush()
+
+    em = TPUExitEmitter(inner, depth=4)
+    rep = PassThrough(em)
+    ch = Channel()
+    port = QueuePort(ch)
+    w = Worker("idle_test", [rep], channel=ch)
+    w.start()
+    port.send(_batch(0, n=2))
+    deadline = time.time() + 5.0
+    while not inner.events and time.time() < deadline:
+        time.sleep(0.02)  # idle tick (50 ms default) must deliver
+    assert [e[1] for e in inner.events] == [0, 1]
+    port.send_eos()
+    w.join(timeout=5.0)
+    assert not w.is_alive() and w.error is None
+
+
+def test_split_on_idle_reaches_nested_exit_fifo():
+    """A TPU->CPU split branch nests a TPUExitEmitter inside the splitting
+    emitter; the splitter's idle tick must reach it."""
+    from windflow_tpu.tpu.emitters_tpu import (TPUExitEmitter,
+                                               TPUSplittingEmitter)
+
+    inner = RecordingInner()
+    exit_em = TPUExitEmitter(inner, depth=4)
+    split = TPUSplittingEmitter(lambda p: 0, [exit_em])
+    split.emit_device_batch(_batch(0, n=2))
+    assert inner.events == []  # parked: splitter FIFO, then exit FIFO
+    split.on_idle()
+    assert [e[1] for e in inner.events] == [0, 1]
+
+
+def test_native_channel_get_timeout():
+    from windflow_tpu.native import NativeChannel, native_available
+
+    if not native_available():
+        import pytest
+        pytest.skip("native runtime not buildable here")
+    ch = NativeChannel(16)
+    ch.register_input()
+    assert ch.get(timeout=0.05) is None
+    ch.put(0, {"v": 1})
+    assert ch.get(timeout=0.05) == (0, {"v": 1})
+
+
+def test_split_fifo_routes_in_order():
+    from windflow_tpu.tpu.emitters_tpu import TPUSplittingEmitter
+
+    class BranchRecorder:
+        def __init__(self):
+            self.rows = []
+            self.num_dests = 1
+            self.flushed = False
+
+        def emit_device_batch(self, b):
+            self.rows.extend(np.asarray(b.fields["v"])[:b.size].tolist())
+
+        def set_stats(self, s):
+            pass
+
+        def propagate_punctuation(self, wm):
+            pass
+
+        def flush(self):
+            self.flushed = True
+
+        def send_eos_all(self):
+            pass
+
+        def eos_ports(self):
+            return []
+
+    b0, b1 = BranchRecorder(), BranchRecorder()
+    em = TPUSplittingEmitter(lambda p: p["v"] % 2, [b0, b1])
+    em.depth = 2
+    for v0 in (0, 10, 20):
+        em.emit_device_batch(_batch(v0))
+    # depth=2: exactly the first batch has been routed so far
+    assert b0.rows == [0, 2] and b1.rows == [1, 3]
+    em.flush()
+    assert b0.rows == [0, 2, 10, 12, 20, 22]
+    assert b1.rows == [1, 3, 11, 13, 21, 23]
+    assert b0.flushed and b1.flushed

@@ -146,10 +146,15 @@ class NativeChannel:
         ctypes.pythonapi.Py_IncRef(ctypes.py_object(msg))
         _lib.wf_queue_push(self._h, ch_idx, handle)
 
-    def get(self) -> Tuple[int, Any]:
+    def get(self, timeout=None):
+        """Blocking pop; with ``timeout`` (seconds) returns None if the
+        queue stays empty (same idle-tick contract as Channel.get)."""
         tag = ctypes.c_int64()
         handle = ctypes.c_size_t()
-        _lib.wf_queue_pop(self._h, ctypes.byref(tag), ctypes.byref(handle), -1)
+        ms = -1 if timeout is None else max(1, int(timeout * 1000))
+        if not _lib.wf_queue_pop(self._h, ctypes.byref(tag),
+                                 ctypes.byref(handle), ms):
+            return None
         msg = ctypes.cast(handle.value, ctypes.py_object).value
         ctypes.pythonapi.Py_DecRef(ctypes.py_object(msg))
         return tag.value, msg

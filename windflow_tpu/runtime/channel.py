@@ -52,10 +52,13 @@ class Channel:
             self._q.append((ch_idx, msg))
             self._not_empty.notify()
 
-    def get(self) -> Tuple[int, Any]:
+    def get(self, timeout: Optional[float] = None) -> Optional[Tuple[int, Any]]:
+        """Blocking pop; with ``timeout`` (seconds) returns None if the
+        channel stays empty that long (the worker's idle tick)."""
         with self._not_empty:
             while not self._q:
-                self._not_empty.wait()
+                if not self._not_empty.wait(timeout) and not self._q:
+                    return None
             item = self._q.popleft()
             self._not_full.notify()
             return item

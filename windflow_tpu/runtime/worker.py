@@ -62,8 +62,23 @@ class Worker(threading.Thread):
             return
         n_inputs = self.channel.n_inputs
         has_coll = hasattr(head, "on_channel_eos")
+        # emitters that pipeline work (D2H FIFOs) must not withhold
+        # results forever on an idle stream: poll with a timeout and give
+        # them an idle tick when the channel stays quiet
+        import os
+
+        idle_emitters = [em for node in self.chain
+                         if (em := getattr(node, "emitter", None)) is not None
+                         and hasattr(em, "on_idle")]
+        idle_s = (float(os.environ.get("WF_IDLE_DRAIN_MS", "50")) / 1e3
+                  if idle_emitters else None)
         while self._eos_seen < n_inputs:
-            ch, msg = self.channel.get()
+            item = self.channel.get(idle_s)
+            if item is None:  # idle tick
+                for em in idle_emitters:
+                    em.on_idle()
+                continue
+            ch, msg = item
             if isinstance(msg, EOS):
                 self._eos_seen += 1
                 if has_coll:

@@ -140,6 +140,18 @@ class BatchTPU(StreamMsg):
         return BatchTPU(dev_fields, ts2, n, schema, wm, keys)
 
     # -- exit to host ------------------------------------------------------
+    def prefetch_host(self) -> None:
+        """Start async D2H of every column (the reference's
+        ``prefetch2CPU``, ``batch_gpu_t_u.hpp:203``). On the tunneled TPU a
+        synchronous fetch of a fresh device buffer costs ~70 ms of fixed
+        latency regardless of size; issuing the copies early lets them
+        overlap each other and subsequent compute, after which
+        ``np.asarray`` reads the cached host copy for free."""
+        for v in self.fields.values():
+            f = getattr(v, "copy_to_host_async", None)
+            if f is not None:
+                f()
+
     def to_rows(self) -> List[Tuple[Any, int]]:
         """TPU->CPU (the reference's ``transfer2CPU``,
         ``batch_gpu_t.hpp:154-165``)."""

@@ -21,6 +21,8 @@
 
 from __future__ import annotations
 
+import os
+from collections import deque
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -213,6 +215,40 @@ class TPUBroadcastEmitter(BasicEmitter):
             self.ports[d].send(out)
 
 
+class _D2HPipeline:
+    """FIFO of device batches with async host copies in flight. On the
+    tunneled TPU a synchronous fetch of a fresh device buffer costs ~70 ms
+    of FIXED latency (size-independent); overlapping ``depth`` fetches
+    amortizes it (8 overlapped fetches measured ~90 ms total vs ~565 ms
+    serial — scripts/profile_d2h.py). A queued batch is processed when a
+    later batch pushes it out or a drain point (single-row emit,
+    punctuation, flush, EOS) forces ordering. Latency-sensitive exits can
+    set depth 0 (immediate, synchronous D2H) via the env knobs."""
+
+    def _pipe_init(self, env_var: str, default: int,
+                   depth: Optional[int] = None) -> None:
+        self.depth = (depth if depth is not None
+                      else int(os.environ.get(env_var, str(default))))
+        self._pending: "deque[BatchTPU]" = deque()
+
+    def _pipe_process(self, batch: BatchTPU) -> None:
+        raise NotImplementedError
+
+    def _pipe_add(self, batch: BatchTPU) -> None:
+        self._pending.append(batch)
+        while len(self._pending) > self.depth:
+            self._pipe_process(self._pending.popleft())
+
+    def _drain(self) -> None:
+        while self._pending:
+            self._pipe_process(self._pending.popleft())
+
+    def on_idle(self) -> None:
+        """Worker idle tick: deliver queued batches — an idle stream must
+        not withhold already-computed results (Worker._process)."""
+        self._drain()
+
+
 _HASH_MODULUS = (1 << 61) - 1  # CPython hash(n) == n iff 0 <= n < 2^61-1
 
 
@@ -308,7 +344,7 @@ class TPUKeyByEmitter(BasicEmitter):
             self.ports[d].send(sub)
 
 
-class TPUSplittingEmitter(BasicEmitter):
+class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
     """Device-plane split (reference ``wf/splitting_emitter_gpu.hpp:48-341``,
     wired at ``wf/multipipe.hpp:698-708``): routes per-branch sub-batches
     after a TPU operator. The reference transfers the whole batch to host
@@ -329,6 +365,8 @@ class TPUSplittingEmitter(BasicEmitter):
                          execution_mode)
         self.splitting_logic = splitting_logic
         self.inner = inner_emitters
+        # the routing decision needs a D2H read; pipeline it (_D2HPipeline)
+        self._pipe_init("WF_SPLIT_PIPELINE_DEPTH", 2)
 
     def set_stats(self, stats) -> None:
         self.stats = stats
@@ -365,7 +403,7 @@ class TPUSplittingEmitter(BasicEmitter):
                     sel[check_branch_index(b, n_branches)].append(i)
         return [np.asarray(ix, dtype=np.int64) for ix in sel]
 
-    def emit_device_batch(self, batch: BatchTPU) -> None:
+    def _pipe_process(self, batch: BatchTPU) -> None:
         per_branch = self._branch_rows(batch)
         for b, idx in enumerate(per_branch):
             if idx.size == 0:
@@ -378,15 +416,37 @@ class TPUSplittingEmitter(BasicEmitter):
                 sub = gather_sub_batch(batch, idx)
             self.inner[b].emit_device_batch(sub)
 
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        logic = self.splitting_logic
+        if isinstance(logic, str):
+            f = getattr(batch.fields[logic], "copy_to_host_async", None)
+            if f is not None:
+                f()
+        else:
+            batch.prefetch_host()  # callable logic reads every column
+        self._pipe_add(batch)
+
+    def on_idle(self) -> None:
+        # drain our routing FIFO, then the branch emitters' own FIFOs
+        # (a TPU->CPU branch nests a TPUExitEmitter the worker can't see)
+        self._drain()
+        for e in self.inner:
+            f = getattr(e, "on_idle", None)
+            if f is not None:
+                f()
+
     def propagate_punctuation(self, wm: int) -> None:
+        self._drain()
         for e in self.inner:
             e.propagate_punctuation(wm)
 
     def flush(self) -> None:
+        self._drain()
         for e in self.inner:
             e.flush()
 
     def send_eos_all(self) -> None:
+        self._drain()
         for e in self.inner:
             e.send_eos_all()
 
@@ -394,14 +454,27 @@ class TPUSplittingEmitter(BasicEmitter):
         return [p for e in self.inner for p in e.eos_ports()]
 
 
-class TPUExitEmitter(BasicEmitter):
+class TPUExitEmitter(BasicEmitter, _D2HPipeline):
     """TPU->CPU: D2H the batch, then route rows through a wrapped CPU
-    emitter (which owns the real ports and batching policy)."""
+    emitter (which owns the real ports and batching policy).
 
-    def __init__(self, inner: BasicEmitter) -> None:
+    The D2H is PIPELINED (_D2HPipeline): an arriving batch starts async
+    host copies of its columns and enters the FIFO; rows materialize only
+    when a later batch pushes it out, a punctuation/flush/EOS drains it,
+    or the worker's idle tick (WF_IDLE_DRAIN_MS, default 50 ms) fires on
+    a quiet stream. Ordering and watermark monotonicity hold; the delay
+    bound is the idle tick on a quiet stream, and on a busy stream with
+    sparse output batches one watermark-punctuation interval
+    (DEFAULT_WM_INTERVAL_USEC) — set WF_EXIT_PIPELINE_DEPTH=0 for
+    latency-sensitive exits. The reference
+    gets the same overlap from ``prefetch2CPU`` on the batch's CUDA
+    stream ahead of the host read (``batch_gpu_t.hpp:154-165``)."""
+
+    def __init__(self, inner: BasicEmitter, depth: Optional[int] = None) -> None:
         super().__init__(inner.num_dests, inner.output_batch_size,
                          inner.execution_mode)
         self.inner = inner
+        self._pipe_init("WF_EXIT_PIPELINE_DEPTH", 4, depth)
 
     def set_ports(self, ports) -> None:
         self.inner.set_ports(ports)
@@ -411,23 +484,31 @@ class TPUExitEmitter(BasicEmitter):
         self.stats = stats
         self.inner.stats = stats
 
-    def emit_device_batch(self, batch: BatchTPU) -> None:
+    def _pipe_process(self, batch: BatchTPU) -> None:
         if self.stats is not None:
             self.stats.device_bytes_d2h += batch.nbytes()
         for payload, ts in batch.to_rows():
             self.inner.emit(payload, ts, batch.wm)
 
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        batch.prefetch_host()
+        self._pipe_add(batch)
+
     def emit(self, payload: Any, ts: int, wm: int,
              msg_id: Optional[int] = None) -> None:
+        self._drain()  # single-row emits must not overtake queued batches
         self.inner.emit(payload, ts, wm, msg_id)
 
     def propagate_punctuation(self, wm: int) -> None:
+        self._drain()  # rows behind the punctuation carry older watermarks
         self.inner.propagate_punctuation(wm)
 
     def flush(self) -> None:
+        self._drain()
         self.inner.flush()
 
     def send_eos_all(self) -> None:
+        self._drain()
         self.inner.send_eos_all()
 
     def eos_ports(self):
