@@ -247,22 +247,29 @@ def _sync(sink: "_CountingEmitter") -> None:
         jax.block_until_ready(list(sink.last_batch.fields.values()))
 
 
-def _run_op_config(make_op, n_keys: int, n_batches: int):
-    """Generic device-op throughput: pre-staged keyed batches -> op."""
+def _run_op_config(make_op, n_keys: int, n_batches: int,
+                   repeats: int = 1):
+    """Generic device-op throughput: pre-staged keyed batches -> op.
+    Best contiguous chunk of ``repeats`` (same protocol as _run_config)."""
     op = make_op()
     op.build_replicas()
     rep = op.replicas[0]
     sink = _CountingEmitter()
     rep.emitter = sink
-    bs = _stage_batches(n_keys, n_batches + WARMUP, 1, with_ts=False)
+    bs = _stage_batches(n_keys, repeats * n_batches + WARMUP, 1,
+                        with_ts=False)
     for b in bs[:WARMUP]:
         rep.handle_msg(0, b)
     _sync(sink)  # warmup compute must not bleed into the timed region
-    t0 = time.perf_counter()
-    for b in bs[WARMUP:]:
-        rep.handle_msg(0, b)
-    _sync(sink)
-    return n_batches * BATCH / (time.perf_counter() - t0)
+    best = 0.0
+    for r in range(repeats):
+        lo = WARMUP + r * n_batches
+        t0 = time.perf_counter()
+        for b in bs[lo:lo + n_batches]:
+            rep.handle_msg(0, b)
+        _sync(sink)
+        best = max(best, n_batches * BATCH / (time.perf_counter() - t0))
+    return best
 
 
 def main() -> None:
@@ -320,11 +327,12 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
         lambda: Map_TPU(lambda row, st: ({**row, "value": row["value"]
                                           + st["n"]}, {"n": st["n"] + 1}),
                         key_extractor="key", state_init={"n": jnp.int32(0)},
-                        name="bench_smap"), 64, 24)
+                        name="bench_smap"), 64, 12, repeats=REPEATS)
     kred_tps = _run_op_config(
         lambda: Reduce_TPU(lambda a, b: {"key": b["key"],
                                          "value": a["value"] + b["value"]},
-                           key_extractor="key", name="bench_kred"), 256, 24)
+                           key_extractor="key", name="bench_kred"), 256, 12,
+        repeats=REPEATS)
     print(f"bench: stateful map {smap_tps:,.0f} t/s, "
           f"keyed reduce {kred_tps:,.0f} t/s", file=sys.stderr)
 

@@ -32,6 +32,11 @@ from windflow_tpu import (ExecutionMode, Filter_Builder, Map_Builder,
 from windflow_tpu.kafka import Kafka_Source_Builder, MemoryBroker
 
 USE_TPU = os.environ.get("YSB_CPU") != "1"
+# YSB_DEVICE_CHAIN=1 moves the view-filter and the ad->campaign join onto
+# the device plane too (Filter_TPU + Map_TPU ahead of the windows): the
+# CPU plane then only runs the per-message Kafka deser, and the whole
+# filter/join/window chain is XLA programs over columnar batches.
+DEVICE_CHAIN = USE_TPU and os.environ.get("YSB_DEVICE_CHAIN") == "1"
 BATCH = int(os.environ.get("YSB_BATCH", "4096"))
 N_CAMPAIGNS = 100
 ADS_PER_CAMPAIGN = 10
@@ -89,13 +94,26 @@ def main(n_events: int = 60_000) -> None:
            .with_topics("ad_events").with_idleness(100)
            .with_parallelism(2)
            .with_output_batch_size(BATCH if USE_TPU else 0).build())
-    views = Filter_Builder(lambda e: e.event_type == 0).with_parallelism(2) \
-        .with_output_batch_size(BATCH if USE_TPU else 0).build()
-    # ad -> campaign join against the static campaign table
-    project = (Map_Builder(lambda e: CampaignEvent(
-                   e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts, e.ing))
-               .with_parallelism(2)
-               .with_output_batch_size(BATCH if USE_TPU else 0).build())
+    if DEVICE_CHAIN:
+        from windflow_tpu.tpu import Filter_TPU_Builder, Map_TPU_Builder
+        views = (Filter_TPU_Builder(lambda f: f["event_type"] == 0)
+                 .build())
+        # ad -> campaign join on device (static-table join = int division
+        # here; a general table is one device-LUT gather)
+        project = (Map_TPU_Builder(
+                       lambda f: {"campaign": f["ad_id"] // ADS_PER_CAMPAIGN,
+                                  "one": f["event_type"] * 0 + 1,
+                                  "ing": f["ing"]})
+                   .build())
+    else:
+        views = (Filter_Builder(lambda e: e.event_type == 0)
+                 .with_parallelism(2)
+                 .with_output_batch_size(BATCH if USE_TPU else 0).build())
+        # ad -> campaign join against the static campaign table
+        project = (Map_Builder(lambda e: CampaignEvent(
+                       e.ad_id // ADS_PER_CAMPAIGN, 1, e.ts, e.ing))
+                   .with_parallelism(2)
+                   .with_output_batch_size(BATCH if USE_TPU else 0).build())
 
     if USE_TPU:
         from windflow_tpu.tpu import Ffat_Windows_TPU_Builder

@@ -173,6 +173,46 @@ def test_native_channel_get_timeout():
     assert ch.get(timeout=0.05) == (0, {"v": 1})
 
 
+def test_keyed_window_on_device_computed_key():
+    """All-device chain (YSB shape): the window key is computed ON DEVICE
+    by an upstream Map_TPU, so the FFAT replica reads the key column via
+    D2H fallback (prefetched by the forward emitter's key hint)."""
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Ffat_Windows_TPU_Builder, Map_TPU_Builder
+
+    N, GROUPS = 300, 4
+    results = {}
+
+    def src(shipper, ctx):
+        for i in range(N):
+            shipper.push_with_timestamp({"item": i, "one": 1}, i * 10)
+            if i % 20 == 19:
+                shipper.set_next_watermark(i * 10)
+
+    graph = PipeGraph("device_key", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+    mp = graph.add_source(
+        Source_Builder(src).with_output_batch_size(64).build())
+    mp.add(Map_TPU_Builder(lambda f: {"grp": f["item"] % GROUPS,
+                                      "one": f["one"]}).build())
+    mp.add(Ffat_Windows_TPU_Builder(
+        lambda f: {"count": f["one"]},
+        lambda a, b: {"count": a["count"] + b["count"]})
+        .with_key_by("grp").with_tb_windows(1000, 1000)
+        .with_key_capacity(GROUPS).build())
+    mp.add_sink(Sink_Builder(
+        lambda r, ctx: results.__setitem__((r["grp"], r["wid"]), r["count"])
+        if r is not None and r["valid"] else None).build())
+    graph.run()
+
+    expected = {}
+    for i in range(N):
+        expected[(i % GROUPS, (i * 10) // 1000)] = \
+            expected.get((i % GROUPS, (i * 10) // 1000), 0) + 1
+    assert results == expected
+
+
 def test_split_fifo_routes_in_order():
     from windflow_tpu.tpu.emitters_tpu import TPUSplittingEmitter
 
@@ -201,8 +241,7 @@ def test_split_fifo_routes_in_order():
             return []
 
     b0, b1 = BranchRecorder(), BranchRecorder()
-    em = TPUSplittingEmitter(lambda p: p["v"] % 2, [b0, b1])
-    em.depth = 2
+    em = TPUSplittingEmitter(lambda p: p["v"] % 2, [b0, b1], depth=2)
     for v0 in (0, 10, 20):
         em.emit_device_batch(_batch(v0))
     # depth=2: exactly the first batch has been routed so far

@@ -70,8 +70,9 @@ class Worker(threading.Thread):
         idle_emitters = [em for node in self.chain
                          if (em := getattr(node, "emitter", None)) is not None
                          and hasattr(em, "on_idle")]
-        idle_s = (float(os.environ.get("WF_IDLE_DRAIN_MS", "50")) / 1e3
-                  if idle_emitters else None)
+        idle_ms = float(os.environ.get("WF_IDLE_DRAIN_MS", "50"))
+        # <= 0 disables the tick (a 0 timeout would busy-spin when idle)
+        idle_s = idle_ms / 1e3 if idle_emitters and idle_ms > 0 else None
         while self._eos_seen < n_inputs:
             item = self.channel.get(idle_s)
             if item is None:  # idle tick

@@ -208,9 +208,14 @@ class PipeGraph:
                                        self.execution_mode,
                                        key_field=first.key_field)
             if routing is RoutingMode.BROADCAST:
-                return TPUBroadcastEmitter(n_dests, 0, self.execution_mode)
-            return TPUForwardEmitter(1 if one_to_one else n_dests, 0,
-                                     self.execution_mode)
+                em = TPUBroadcastEmitter(n_dests, 0, self.execution_mode)
+            else:
+                em = TPUForwardEmitter(1 if one_to_one else n_dests, 0,
+                                       self.execution_mode)
+            # keyed consumer fed by forward/broadcast: prefetch its key
+            # column so a device-computed key never costs a sync D2H
+            em.prefetch_field = getattr(first, "key_field", None)
+            return em
         if routing is RoutingMode.KEYBY:
             # key_extractor is normalized to a callable by BasicOperator
             em: BasicEmitter = KeyByEmitter(first.key_extractor, n_dests,

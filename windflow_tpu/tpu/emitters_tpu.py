@@ -189,10 +189,35 @@ class TPUStageEmitter(BasicEmitter):
         self.ports[dest].send(batch)
 
 
+def _async_copy(arr: Any) -> None:
+    """Start an async host copy of one device column (no-op for plain
+    numpy arrays on the CPU backend)."""
+    f = getattr(arr, "copy_to_host_async", None)
+    if f is not None:
+        f()
+
+
+def _maybe_prefetch_key(batch: BatchTPU, field: Optional[str]) -> None:
+    """Start an async host copy of the key column when the downstream
+    keyed device op will have to read it (no host key metadata on the
+    batch — e.g. the key was computed ON DEVICE by an upstream Map_TPU).
+    Without this, the consumer's key read is a synchronous D2H of a fresh
+    buffer (~70 ms fixed on the tunneled TPU)."""
+    if field is None or batch.host_keys is not None:
+        return
+    if field in batch.fields:
+        _async_copy(batch.fields[field])
+
+
 class TPUForwardEmitter(BasicEmitter):
-    """TPU->TPU forward: whole batches round-robin."""
+    """TPU->TPU forward: whole batches round-robin. ``prefetch_field``
+    (set by the graph wiring) names the consumer's key column for the
+    async-prefetch above."""
+
+    prefetch_field: Optional[str] = None
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
+        _maybe_prefetch_key(batch, self.prefetch_field)
         d = getattr(self, "_rr", 0)
         batch.id = self._next_ids[d]
         self._next_ids[d] += 1
@@ -205,7 +230,10 @@ class TPUForwardEmitter(BasicEmitter):
 class TPUBroadcastEmitter(BasicEmitter):
     """TPU->TPU broadcast: immutable device arrays are shared."""
 
+    prefetch_field: Optional[str] = None
+
     def emit_device_batch(self, batch: BatchTPU) -> None:
+        _maybe_prefetch_key(batch, self.prefetch_field)
         for d in range(self.num_dests):
             out = batch.copy_for_dest() if d > 0 else batch
             out.id = self._next_ids[d]
@@ -360,13 +388,14 @@ class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
     """
 
     def __init__(self, splitting_logic, inner_emitters: List[BasicEmitter],
-                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT) -> None:
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 depth: Optional[int] = None) -> None:
         super().__init__(sum(e.num_dests for e in inner_emitters), 0,
                          execution_mode)
         self.splitting_logic = splitting_logic
         self.inner = inner_emitters
         # the routing decision needs a D2H read; pipeline it (_D2HPipeline)
-        self._pipe_init("WF_SPLIT_PIPELINE_DEPTH", 2)
+        self._pipe_init("WF_SPLIT_PIPELINE_DEPTH", 2, depth)
 
     def set_stats(self, stats) -> None:
         self.stats = stats
@@ -419,9 +448,7 @@ class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
     def emit_device_batch(self, batch: BatchTPU) -> None:
         logic = self.splitting_logic
         if isinstance(logic, str):
-            f = getattr(batch.fields[logic], "copy_to_host_async", None)
-            if f is not None:
-                f()
+            _async_copy(batch.fields[logic])
         else:
             batch.prefetch_host()  # callable logic reads every column
         self._pipe_add(batch)
