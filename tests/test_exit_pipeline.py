@@ -173,6 +173,19 @@ def test_native_channel_get_timeout():
     assert ch.get(timeout=0.05) == (0, {"v": 1})
 
 
+def test_graft_entry_reexecutes():
+    """Driver contract: entry()'s fn must run repeatedly on the SAME
+    example args (warmup-then-time). The FFAT step donates its forest
+    buffers internally; the entry surface must not."""
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))  # donated args would fail here
+
+
 def test_keyed_window_on_device_computed_key():
     """All-device chain (YSB shape): the window key is computed ON DEVICE
     by an upstream Map_TPU, so the FFAT replica reads the key column via
