@@ -188,6 +188,11 @@ class FfatTPUReplica(TPUReplicaBase):
                 # CI lever: exercise the accelerator segmentation path
                 # (in-program sort) on the CPU backend across the suite
                 self.__host_seg = False
+            elif env_flag("WF_FORCE_HOST_SEG"):
+                # perf lever: host radix segmentation on an accelerator —
+                # TPU sorts are bitonic O(n log^2 n); the host's int16
+                # radix argsort overlapped with device compute can win
+                self.__host_seg = True
             else:
                 self.__host_seg = jax.default_backend() == "cpu"
         return self.__host_seg
@@ -195,6 +200,20 @@ class FfatTPUReplica(TPUReplicaBase):
     @_host_seg.setter
     def _host_seg(self, v) -> None:
         self.__host_seg = v
+
+    def _on_accelerator(self) -> bool:
+        """Backend test for policy decisions (two-tier fire budgets).
+        NOT the same as ``not _host_seg``: WF_FORCE_HOST_SEG runs host
+        segmentation on an accelerator, where the wide-tier budget
+        rationale (dispatches are the cost, wide queries are overlapped
+        device work) still applies. WF_FORCE_DEVICE_SEG keeps implying
+        accelerator policy so CI exercises the two-tier path on CPU."""
+        import jax
+
+        from ..basic import env_flag
+
+        return (env_flag("WF_FORCE_DEVICE_SEG")
+                or jax.default_backend() != "cpu")
 
     # ==================================================================
     # the per-batch device program
@@ -754,12 +773,12 @@ class FfatTPUReplica(TPUReplicaBase):
         """Fire budget for the first (full) program of a batch — one of
         exactly TWO tiers (both compiled eagerly, so no mid-stream
         retrace ever): the small W_step block, or W_cap when the recent
-        fire rate overflows it. Device mode only: the wide query block is
-        overlapped device work there and saves two host dispatches per
+        fire rate overflows it. Accelerators only: the wide query block
+        is overlapped device work there and saves two host dispatches per
         batch, while on the CPU backend the drain path's fire-only
         program (no lift/sort/rebuild) is much cheaper than widening the
         full program."""
-        if self._host_seg or self._fire_ewma * 1.25 <= self.W_step:
+        if not self._on_accelerator() or self._fire_ewma * 1.25 <= self.W_step:
             return self.W_step
         return self.W_cap
 
@@ -839,7 +858,7 @@ class FfatTPUReplica(TPUReplicaBase):
                                       ckey, lambda: self._make_step(cap))
                 if fresh:
                     self._warm_fire_step()
-                    if not self._host_seg and self.W_cap != self.W_step:
+                    if self._on_accelerator() and self.W_cap != self.W_step:
                         # eagerly compile the OTHER tier's shape of the
                         # full program (all-sentinel no-op run, outputs
                         # discarded; the real call below traces this
