@@ -186,10 +186,15 @@ def test_graft_entry_reexecutes():
     jax.block_until_ready(fn(*args))  # donated args would fail here
 
 
-def test_keyed_window_on_device_computed_key():
+import pytest
+
+
+@pytest.mark.parametrize("win_par", [1, 2])
+def test_keyed_window_on_device_computed_key(win_par):
     """All-device chain (YSB shape): the window key is computed ON DEVICE
-    by an upstream Map_TPU, so the FFAT replica reads the key column via
-    D2H fallback (prefetched by the forward emitter's key hint)."""
+    by an upstream Map_TPU, so the key column is read via D2H fallback
+    (prefetched by the forward emitter's key hint at par=1; routed through
+    the TPUKeyByEmitter's D2H FIFO at par=2)."""
     from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
                               Source_Builder, TimePolicy)
     from windflow_tpu.tpu import Ffat_Windows_TPU_Builder, Map_TPU_Builder
@@ -213,6 +218,7 @@ def test_keyed_window_on_device_computed_key():
         lambda f: {"count": f["one"]},
         lambda a, b: {"count": a["count"] + b["count"]})
         .with_key_by("grp").with_tb_windows(1000, 1000)
+        .with_parallelism(win_par)
         .with_key_capacity(GROUPS).build())
     mp.add_sink(Sink_Builder(
         lambda r, ctx: results.__setitem__((r["grp"], r["wid"]), r["count"])

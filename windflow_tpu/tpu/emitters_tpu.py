@@ -316,16 +316,23 @@ def gather_sub_batch(batch: BatchTPU, idx: np.ndarray,
     return sub
 
 
-class TPUKeyByEmitter(BasicEmitter):
+class TPUKeyByEmitter(BasicEmitter, _D2HPipeline):
     """TPU->TPU keyed re-shard: per-destination sub-batches gathered on
-    device with host-computed index vectors."""
+    device with host-computed index vectors.
+
+    Batches WITHOUT host key metadata (key computed on device upstream)
+    need a D2H of the key column before routing; those go through the
+    _D2HPipeline FIFO with an async copy in flight. Batches WITH metadata
+    route immediately (after draining the FIFO, preserving order)."""
 
     def __init__(self, key_extractor: Callable, num_dests: int,
                  execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
-                 key_field: Optional[str] = None) -> None:
+                 key_field: Optional[str] = None,
+                 depth: Optional[int] = None) -> None:
         super().__init__(num_dests, 0, execution_mode)
         self.key_extractor = key_extractor
         self.key_field = key_field
+        self._pipe_init("WF_KEYBY_PIPELINE_DEPTH", 2, depth)
 
     def _keys_of(self, batch: BatchTPU):
         if batch.host_keys is not None:
@@ -338,15 +345,28 @@ class TPUKeyByEmitter(BasicEmitter):
         return key_column_to_list(batch, self.key_field)
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
-        import jax
-
         if self.num_dests == 1:
+            self._drain()
             batch.id = self._next_ids[0]
             self._next_ids[0] += 1
             if self.stats is not None:
                 self.stats.outputs_sent += batch.size
             self.ports[0].send(batch)
             return
+        if batch.host_keys is None and self.key_field is not None:
+            _async_copy(batch.fields.get(self.key_field))
+            self._pipe_add(batch)
+            return
+        self._drain()  # keep stream order ahead of an immediate route
+        self._pipe_process(batch)
+
+    def flush(self) -> None:
+        # BasicEmitter's propagate_punctuation/send_eos_all call flush()
+        # first, so draining here covers every ordering point
+        self._drain()
+        super().flush()
+
+    def _pipe_process(self, batch: BatchTPU) -> None:
         host_keys = self._keys_of(batch)
         if (isinstance(host_keys, np.ndarray)
                 and _int_keys_hashable_as_identity(host_keys[:batch.size],

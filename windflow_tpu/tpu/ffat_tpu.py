@@ -159,6 +159,7 @@ class FfatTPUReplica(TPUReplicaBase):
         self.tvalid = None  # (K_cap, 2F) bool
         self._prog_cache = op._prog_cache  # shared across replicas
         self.__host_seg = None  # resolved lazily: backend init is costly
+        self.__on_accel = None  # same caching rationale (_on_accelerator)
         self._check_index_plane()
 
     def _comp_dtype(self):
@@ -207,13 +208,16 @@ class FfatTPUReplica(TPUReplicaBase):
         segmentation on an accelerator, where the wide-tier budget
         rationale (dispatches are the cost, wide queries are overlapped
         device work) still applies. WF_FORCE_DEVICE_SEG keeps implying
-        accelerator policy so CI exercises the two-tier path on CPU."""
-        import jax
+        accelerator policy so CI exercises the two-tier path on CPU.
+        Cached: called per batch on the hot dispatch path."""
+        if self.__on_accel is None:
+            import jax
 
-        from ..basic import env_flag
+            from ..basic import env_flag
 
-        return (env_flag("WF_FORCE_DEVICE_SEG")
-                or jax.default_backend() != "cpu")
+            self.__on_accel = (env_flag("WF_FORCE_DEVICE_SEG")
+                               or jax.default_backend() != "cpu")
+        return self.__on_accel
 
     # ==================================================================
     # the per-batch device program
