@@ -150,6 +150,46 @@ def bench_exit_decode() -> None:
     report("exit_from_columns", n / (time.perf_counter() - t0), "rows/sec")
 
 
+def bench_exit_pipeline() -> None:
+    """TPU->CPU exit: full device batches -> rows through TPUExitEmitter,
+    pipelined (depth 4, default) vs synchronous (depth 0). On the
+    tunneled TPU the sync fetch of a fresh buffer costs ~70 ms fixed, so
+    the two depths differ by orders of magnitude there; on the CPU
+    backend they should be close."""
+    import jax
+
+    from windflow_tpu.basic import ExecutionMode
+    from windflow_tpu.runtime.emitters import ForwardEmitter
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    n, batches = 16384, 12
+    schema = TupleSchema({"a": np.int32, "b": np.float32})
+
+    @jax.jit
+    def bump(a, b):  # fresh device buffers per batch (no host cache)
+        return a + 1, b * 2
+
+    for depth in (4, 0):
+        inner = ForwardEmitter(1, 256, ExecutionMode.DEFAULT)
+        em = TPUExitEmitter(inner, depth=depth)
+        em.set_ports([_NullPort()])
+        staged = []
+        for i in range(batches):
+            a, b = bump(jax.device_put(np.arange(n, dtype=np.int32) + i),
+                        jax.device_put(np.arange(n, dtype=np.float32)))
+            staged.append(BatchTPU({"a": a, "b": b},
+                                   np.arange(n, dtype=np.int64), n, schema))
+        jax.block_until_ready([bt.fields["a"] for bt in staged])
+        t0 = time.perf_counter()
+        for bt in staged:
+            em.emit_device_batch(bt)
+        em.flush()
+        report(f"exit_pipeline_depth{depth}",
+               batches * n / (time.perf_counter() - t0), "rows/sec")
+
+
 def bench_cpu_plane() -> None:
     """Per-tuple Python plane: 3-op chain end-to-end (the CPU plane is
     functor-bound by design; the device plane is the throughput story)."""
@@ -180,6 +220,7 @@ def main() -> None:
     bench_reshard()
     bench_channels()
     bench_exit_decode()
+    bench_exit_pipeline()
     bench_cpu_plane()
 
 
