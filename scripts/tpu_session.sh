@@ -1,55 +1,38 @@
 #!/bin/bash
 # TPU validation session: run the most important measurements first so a
-# short tunnel window still yields the critical numbers.
+# short tunnel window still yields the critical numbers. Each stage
+# records the active backend (the tunnel can die mid-session; a CPU
+# fallback must be visible in the logs, not silently labeled TPU).
 cd "$(dirname "$0")/.."
-L=${WF_SESSION_LOG_DIR:-/tmp/tpu_session}
-mkdir -p $L
-echo "=== session start $(date -u +%H:%M:%S) ===" | tee $L/status
+L="${WF_SESSION_LOG_DIR:-/tmp/tpu_session}"
+mkdir -p "$L"
+echo "=== session start $(date -u +%H:%M:%S) ===" | tee "$L/status"
 
-# 1. the driver-facing benchmark, final code
-timeout 2400 python bench.py > $L/bench.log 2>&1
-echo "bench rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
-tail -1 $L/bench.log >> $L/status
+# 1. the driver-facing benchmark (probes the backend itself)
+timeout 2400 python bench.py > "$L/bench.log" 2>&1
+echo "bench rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+tail -1 "$L/bench.log" >> "$L/status"
 
-# 2. pallas rebuild A/B on the FFAT configs
-timeout 1200 python - > $L/pallas_ab.log 2>&1 <<'EOF'
-import sys; sys.path.insert(0, '.')
-import os
-import bench
-for mode in ("xla", "pallas"):
-    os.environ["WF_PALLAS"] = "1" if mode == "pallas" else "0"
-    tps, wps, _, progs = bench._run_config(bench.N_KEYS, bench.WIN_PER_BATCH, 12, repeats=2)
-    print(f"{mode}: 64keys {tps/1e6:.1f}M t/s ({progs} programs)", flush=True)
-    hc, hcw, _, _ = bench._run_config(bench.HC_KEYS, bench.HC_WIN_PER_BATCH, 6, repeats=2)
-    print(f"{mode}: 10k keys {hc/1e6:.1f}M t/s, {hcw/1e6:.2f}M win/s", flush=True)
-EOF
-echo "pallas_ab rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
-
-# 2b. host-vs-device segmentation A/B on the accelerator
-timeout 1200 python - > $L/seg_ab.log 2>&1 <<'EOF2'
-import sys; sys.path.insert(0, '.')
-import os
-import bench
-for mode in ("device", "host"):
-    os.environ["WF_FORCE_HOST_SEG"] = "1" if mode == "host" else "0"
-    tps, wps, _, progs = bench._run_config(bench.N_KEYS, bench.WIN_PER_BATCH, 12, repeats=2)
-    print(f"seg={mode}: 64keys {tps/1e6:.1f}M t/s ({progs} programs)", flush=True)
-    hc, hcw, _, _ = bench._run_config(bench.HC_KEYS, bench.HC_WIN_PER_BATCH, 6, repeats=2)
-    print(f"seg={mode}: 10k keys {hc/1e6:.1f}M t/s, {hcw/1e6:.2f}M win/s", flush=True)
-EOF2
-echo "seg_ab rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
+# 2. pallas-rebuild and segmentation A/Bs (shared helper, backend logged)
+timeout 1200 python scripts/ab_ffat.py WF_PALLAS xla pallas \
+    > "$L/pallas_ab.log" 2>&1
+echo "pallas_ab rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+timeout 1200 python scripts/ab_ffat.py WF_FORCE_HOST_SEG seg=device seg=host \
+    > "$L/seg_ab.log" 2>&1
+echo "seg_ab rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 
 # 2c. exit-pipeline microbench (depth 4 vs 0 on the real tunnel)
-timeout 900 python scripts/microbench.py > $L/microbench.log 2>&1
-echo "microbench rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
+timeout 900 python scripts/microbench.py > "$L/microbench.log" 2>&1
+echo "microbench rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 
 # 3. host/device split profile (for PERF.md)
-timeout 1200 python scripts/profile_tpu.py > $L/profile.log 2>&1
-echo "profile rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
+timeout 1200 python scripts/profile_tpu.py > "$L/profile.log" 2>&1
+echo "profile rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 
 # 4. YSB steady state on the chip, both chain modes
-timeout 1200 python examples/ysb.py 300000 > $L/ysb.log 2>&1
-echo "ysb rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
-timeout 1200 env YSB_DEVICE_CHAIN=1 python examples/ysb.py 300000 > $L/ysb_chain.log 2>&1
-echo "ysb_chain rc=$? $(date -u +%H:%M:%S)" | tee -a $L/status
-echo "=== session done $(date -u +%H:%M:%S) ===" | tee -a $L/status
+timeout 1200 python examples/ysb.py 300000 > "$L/ysb.log" 2>&1
+echo "ysb rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+timeout 1200 env YSB_DEVICE_CHAIN=1 python examples/ysb.py 300000 \
+    > "$L/ysb_chain.log" 2>&1
+echo "ysb_chain rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+echo "=== session done $(date -u +%H:%M:%S) ===" | tee -a "$L/status"

@@ -35,6 +35,57 @@ def test_failing_replica_unwinds_graph():
         graph.run()
 
 
+def test_device_runtime_failure_unwinds_graph():
+    """The device RUNTIME (not a user functor) dying mid-stream — the
+    tunneled TPU's real failure mode (UNAVAILABLE at dispatch) — must
+    unwind like any replica error: drain, EOS, wait_end re-raises; and a
+    fresh graph afterwards still runs."""
+    from jax.errors import JaxRuntimeError
+
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    graph = PipeGraph("dev_boom")
+    src = (Source_Builder(make_ingress_source(3, 120))
+           .with_parallelism(2).with_output_batch_size(16).build())
+    op = Map_TPU_Builder(lambda f: {**f, "value": f["value"] + 1}).build()
+
+    orig_build = op.build_replicas
+
+    def build_then_sabotage():
+        orig_build()
+        rep = op.replicas[0]
+        orig_handle = rep.handle_msg
+        seen = [0]
+
+        def dying(ch, msg):
+            seen[0] += 1
+            if seen[0] == 3:
+                raise JaxRuntimeError(
+                    "UNAVAILABLE: remote_compile: Connection refused "
+                    "(synthetic relay death)")
+            orig_handle(ch, msg)
+
+        rep.handle_msg = dying
+
+    op.build_replicas = build_then_sabotage
+    graph.add_source(src).add(op).add_sink(
+        Sink_Builder(lambda t: None).build())
+    with pytest.raises(JaxRuntimeError, match="synthetic relay death"):
+        graph.run()
+
+    # the failure must not wedge the process: a new graph still runs
+    acc = [0]
+    g2 = PipeGraph("after")
+    g2.add_source(Source_Builder(make_ingress_source(2, 50))
+                  .with_output_batch_size(16).build()) \
+      .add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 2}).build()) \
+      .add_sink(Sink_Builder(
+          lambda t: acc.__setitem__(0, acc[0] + t.value)
+          if t is not None else None).build())
+    g2.run()
+    assert acc[0] == 2 * 2 * sum(range(1, 51))
+
+
 def test_failing_source_unwinds_graph():
     graph = PipeGraph("boom_src")
 
