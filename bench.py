@@ -13,19 +13,33 @@ Extra fields report the high-cardinality configuration (10k keys) and
 fired-window rates (windows/sec scales with key count under TB sliding
 windows, so tuples/sec alone under-describes that regime).
 
-Tunnel robustness (the axon TPU relay serves ONE client and can stay
-wedged/UNAVAILABLE for long stretches; an abandoned claim errors out only
-after ~35 min):
-- the backend probe runs as a detached subprocess with a deadline and is
-  NEVER killed (killing a client mid-handshake is what wedges the relay);
-  on deadline the probe is abandoned (it self-terminates) and the probe
-  retries up to WF_BENCH_PROBE_ATTEMPTS times with backoff;
-- exhausted attempts re-exec the benchmark on the local CPU backend with
-  the tunnel registration disabled, marking the metric (cpu-fallback).
+Tunnel robustness (the axon TPU relay serves ONE client, claims have
+been OBSERVED to take 25-37 min when the relay is cold, and the relay
+can stay wedged/UNAVAILABLE for long stretches; an abandoned claim
+errors out only after ~35 min). Three layers, in order:
+1. PROBE: a detached subprocess (NEVER killed — killing a client
+   mid-handshake is what wedges the relay) polled under one overall
+   wall-clock budget WF_BENCH_PROBE_BUDGET (default 1200 s). Fast
+   failures (UNAVAILABLE) retry within the budget; a slow healthy claim
+   gets the whole budget.
+2. INGEST: if the probe fails, the freshest persisted real-TPU result
+   from THIS repo (written by any earlier successful platform=tpu run of
+   this benchmark — e.g. during a mid-round tunnel window via
+   scripts/tpu_session.sh) is validated (platform stamp, raw log
+   present, freshness < WF_BENCH_INGEST_MAX_AGE_H) and reported with
+   record="ingested-from-session" fields that RECORD provenance (both
+   git shas, age, artifact path) for the reader to judge. A mid-round
+   tunnel window is never wasted on a cold end-of-round relay.
+3. CPU FALLBACK: otherwise re-exec on the local CPU backend with the
+   tunnel registration disabled, marking the metric (cpu-fallback).
 
-Env knobs: WF_BENCH_PROBE_ATTEMPTS (default 2), WF_BENCH_PROBE_DEADLINE
-seconds per attempt (default 240), WF_BENCH_PROBE_BACKOFF seconds between
-attempts (default 20).
+Every successful platform=tpu run persists its own result + raw log to
+results/bench_tpu_latest.json (the ingest source).
+
+Env knobs: WF_BENCH_PROBE_BUDGET seconds overall (default 1200),
+WF_BENCH_PROBE_BACKOFF seconds between fast-fail retries (default 20),
+WF_BENCH_INGEST_MAX_AGE_H (default 24, 0 disables ingest),
+WF_BENCH_REPEATS (default 5 chunks; mean/p10/best all reported).
 """
 
 from __future__ import annotations
@@ -61,27 +75,45 @@ HC_BATCHES = 8
 # The tunneled TPU's throughput fluctuates run to run (shared relay;
 # +-20% observed, with multi-minute degraded periods right after the
 # relay recovers). The throughput pass is repeated over one continuous
-# stream and the best contiguous chunk is reported (peak sustained
-# per-chip throughput); the latency pass is not repeated.
-REPEATS = int(os.environ.get("WF_BENCH_REPEATS", "3"))
+# stream; mean, p10 and best across chunks are all reported (the
+# headline value is the MEAN — peak-of-N alone overstates a jittery
+# link); the latency pass is not repeated.
+REPEATS = int(os.environ.get("WF_BENCH_REPEATS", "5"))
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "bench_tpu_latest.json")
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10).stdout.strip()
+    except Exception:
+        return "unknown"
 
 
 def _probe_backend() -> bool:
-    attempts = int(os.environ.get("WF_BENCH_PROBE_ATTEMPTS", "2"))
-    deadline = float(os.environ.get("WF_BENCH_PROBE_DEADLINE", "240"))
+    budget = float(os.environ.get("WF_BENCH_PROBE_BUDGET", "1200"))
     backoff = float(os.environ.get("WF_BENCH_PROBE_BACKOFF", "20"))
-    for i in range(attempts):
-        if i:
-            time.sleep(backoff)
-        print(f"bench: probing TPU backend (attempt {i + 1}/{attempts}, "
-              f"deadline {deadline:.0f}s)", file=sys.stderr)
+    t_end = time.monotonic() + budget
+    attempt = 0
+    while time.monotonic() < t_end:
+        attempt += 1
+        if attempt > 1:
+            time.sleep(min(backoff, max(0.0, t_end - time.monotonic())))
+            if time.monotonic() >= t_end:
+                break
+        remaining = t_end - time.monotonic()
+        print(f"bench: probing TPU backend (attempt {attempt}, "
+              f"{remaining:.0f}s of budget left)", file=sys.stderr)
         p = subprocess.Popen(
             [sys.executable, "-c",
              "import jax; jax.devices(); print('ok')"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             start_new_session=True)  # detached: never killed (see docstring)
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < deadline:
+        while time.monotonic() < t_end:
             rc = p.poll()
             if rc is not None:
                 if rc == 0:
@@ -90,10 +122,86 @@ def _probe_backend() -> bool:
                 break  # backend errored (e.g. UNAVAILABLE) -> retry
             time.sleep(1.0)
         else:
-            print("bench: probe deadline exceeded; abandoning the probe "
+            print("bench: probe budget exhausted; abandoning the probe "
                   "process (it self-terminates; killing it would wedge "
                   "the relay)", file=sys.stderr)
     return False
+
+
+def _persist_artifact(result: dict, log_lines: list) -> None:
+    """Persist a successful real-TPU result (+ raw log + provenance) so a
+    later cold-relay run can ingest it instead of falling back to CPU."""
+    try:
+        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+        with open(ARTIFACT, "w") as f:
+            json.dump({
+                "result": result,
+                "platform": "tpu",
+                "measured_at_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "measured_at_epoch": time.time(),
+                "git_sha": _git_sha(),
+                "raw_log": log_lines,
+            }, f, indent=1)
+        print(f"bench: persisted real-TPU artifact -> {ARTIFACT}",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"bench: artifact persist failed ({e}); continuing",
+              file=sys.stderr)
+
+
+def _try_ingest() -> bool:
+    """Report the freshest persisted real-TPU result, if valid. Returns
+    True when a JSON line was printed."""
+    try:
+        max_age_h = float(os.environ.get("WF_BENCH_INGEST_MAX_AGE_H", "24"))
+    except ValueError:
+        max_age_h = 24.0  # malformed knob must not take down the bench
+    if max_age_h <= 0 or not os.path.exists(ARTIFACT):
+        return False
+    try:
+        with open(ARTIFACT) as f:
+            art = json.load(f)
+        result = dict(art["result"])
+        age_h = (time.time() - float(art["measured_at_epoch"])) / 3600.0
+        if art.get("platform") != "tpu":
+            print("bench: ingest rejected (no tpu platform stamp)",
+                  file=sys.stderr)
+            return False
+        if "cpu-fallback" in result.get("metric", ""):
+            print("bench: ingest rejected (artifact is cpu-fallback)",
+                  file=sys.stderr)
+            return False
+        if not art.get("raw_log"):
+            print("bench: ingest rejected (no raw log)", file=sys.stderr)
+            return False
+        if age_h > max_age_h:
+            print(f"bench: ingest rejected (artifact {age_h:.1f}h old "
+                  f"> {max_age_h:.0f}h)", file=sys.stderr)
+            return False
+        measured_at = str(art.get("measured_at_utc", "unknown"))
+        sha_measured = str(art.get("git_sha", "unknown"))
+        for line in art["raw_log"]:
+            print(f"bench(session-log): {line}", file=sys.stderr)
+        result.update({
+            "record": "ingested-from-session",
+            "measured_at_utc": measured_at,
+            "artifact_age_hours": round(age_h, 2),
+            "git_sha_measured": sha_measured,
+            "git_sha_now": _git_sha(),
+            "session_artifact": os.path.relpath(
+                ARTIFACT, os.path.dirname(os.path.abspath(__file__))),
+        })
+        out = json.dumps(result)
+    except Exception as e:
+        print(f"bench: ingest rejected (unreadable artifact: {e})",
+              file=sys.stderr)
+        return False
+    print(f"bench: relay cold now, but a stamped real-TPU result from "
+          f"{measured_at} ({age_h:.1f}h ago, git {sha_measured[:12]}) "
+          f"exists; ingesting it", file=sys.stderr)
+    print(out)
+    return True
 
 
 def _fallback_to_cpu() -> None:
@@ -179,7 +287,9 @@ def _stage_batches(n_keys: int, n_batches: int, seed: int,
 def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
                 lat_batches: int = 0, repeats: int = 1,
                 batch_size: int = 0):
-    """Returns (tuples/s, windows/s, p99 fire latency µs, programs).
+    """Returns (chunks, p99 fire latency µs, programs), where ``chunks``
+    is a list of per-chunk (tuples/s, windows/s) pairs — aggregation
+    (mean/min/best) is the caller's job (_chunk_stats).
 
     Throughput and latency are measured in SEPARATE passes over one
     continuous stream: the throughput pass lets dispatch pipeline freely
@@ -187,8 +297,7 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
     window batch per step — on an async backend a per-batch timer without
     the block would measure dispatch, not window delivery. With
     ``repeats`` > 1 the throughput pass times ``repeats`` contiguous
-    chunks of the stream and reports the best one (tunnel jitter — see
-    REPEATS above)."""
+    chunks of the stream (tunnel jitter — see REPEATS above)."""
     import jax
 
     rep = _make_replica(n_keys, win_per_batch)
@@ -203,7 +312,7 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
         rep.handle_msg(0, b)
     jax.block_until_ready(rep.trees)
 
-    best = (0.0, 0.0)  # (tuples/s, windows/s)
+    chunks = []  # per-chunk (tuples/s, windows/s)
     for r in range(repeats):
         lo = WARMUP + r * n_batches
         w0 = sink.windows
@@ -212,10 +321,8 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
             rep.handle_msg(0, b)
         jax.block_until_ready(rep.trees)
         elapsed = time.perf_counter() - t0
-        chunk = (n_batches * B / elapsed,
-                 (sink.windows - w0) / elapsed)
-        if chunk[0] > best[0]:
-            best = chunk
+        chunks.append((n_batches * B / elapsed,
+                       (sink.windows - w0) / elapsed))
 
     fire_lat = []
     for b in batches[WARMUP + repeats * n_batches:]:
@@ -234,7 +341,7 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
                                    max(0, math.ceil(len(fire_lat) * 0.99)
                                        - 1))] * 1e6
               if fire_lat else 0.0)  # nearest-rank
-    return (best[0], best[1], p99_us, rep.stats.device_programs_run)
+    return (chunks, p99_us, rep.stats.device_programs_run)
 
 
 def _sync(sink: "_CountingEmitter") -> None:
@@ -275,7 +382,10 @@ def _run_op_config(make_op, n_keys: int, n_batches: int,
 def main() -> None:
     fallback = os.environ.get("WF_BENCH_FALLBACK") == "1"
     if not fallback and not _probe_backend():
-        print("bench: TPU backend unreachable; falling back to CPU",
+        print("bench: TPU backend unreachable", file=sys.stderr)
+        if _try_ingest():
+            return
+        print("bench: no ingestible session artifact; falling back to CPU",
               file=sys.stderr)
         _fallback_to_cpu()
 
@@ -292,31 +402,68 @@ def main() -> None:
         if fallback:
             raise
         print(f"bench: TPU backend failed mid-run ({type(e).__name__}: "
-              f"{e}); falling back to CPU", file=sys.stderr)
+              f"{e})", file=sys.stderr)
+        if _try_ingest():
+            return
+        print("bench: no ingestible session artifact; falling back to CPU",
+              file=sys.stderr)
         _fallback_to_cpu()
 
 
+def _chunk_stats(chunks) -> dict:
+    """mean / min / best tuples-per-sec (and mean windows-per-sec) over
+    the timed stream chunks — ONE aggregation (mean) for every headline
+    field; best/min disclose the spread (at REPEATS=5 a percentile label
+    would be dishonest; min is what it is)."""
+    if not chunks:
+        return {"mean": 0.0, "min": 0.0, "best": 0.0, "wps_mean": 0.0}
+    tl = sorted(c[0] for c in chunks)
+    return {"mean": sum(tl) / len(tl), "min": tl[0], "best": tl[-1],
+            "wps_mean": sum(c[1] for c in chunks) / len(chunks)}
+
+
 def _measure_and_report(platform: str, fallback: bool) -> None:
-    tps, wps, p99_us, programs = _run_config(N_KEYS, WIN_PER_BATCH,
-                                             N_BATCHES,
-                                             lat_batches=N_BATCHES,
-                                             repeats=REPEATS)
-    print(f"bench: {N_KEYS} keys -> {tps:,.0f} t/s, {wps:,.0f} win/s, "
-          f"{programs} programs", file=sys.stderr)
-    hc_tps, hc_wps, _, _ = _run_config(HC_KEYS, HC_WIN_PER_BATCH, HC_BATCHES,
-                                       repeats=REPEATS)
-    print(f"bench: {HC_KEYS} keys -> {hc_tps:,.0f} t/s, {hc_wps:,.0f} win/s",
-          file=sys.stderr)
+    log_lines: list = []
+
+    def _log(msg: str) -> None:
+        print(f"bench: {msg}", file=sys.stderr)
+        log_lines.append(msg)
+
+    _log(f"platform={platform} repeats={REPEATS} git={_git_sha()[:12]} "
+         f"at {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}")
+    chunks, p99_us, programs = _run_config(
+        N_KEYS, WIN_PER_BATCH, N_BATCHES, lat_batches=N_BATCHES,
+        repeats=REPEATS)
+    st = _chunk_stats(chunks)
+    wps = st["wps_mean"]
+    _log(f"{N_KEYS} keys 64k batches -> mean {st['mean']:,.0f} / "
+         f"min {st['min']:,.0f} / best {st['best']:,.0f} t/s, "
+         f"{wps:,.0f} win/s (mean), {programs} programs")
+    # the original 16k-batch protocol (same key count / window config):
+    # robustness means >=1x at BOTH operating points, not only the
+    # batch-size sweet spot
+    chunks16, _, _ = _run_config(
+        N_KEYS, WIN_PER_BATCH, 4 * N_BATCHES, repeats=REPEATS,
+        batch_size=16384)
+    st16 = _chunk_stats(chunks16)
+    _log(f"{N_KEYS} keys 16k batches -> mean {st16['mean']:,.0f} / "
+         f"min {st16['min']:,.0f} / best {st16['best']:,.0f} t/s")
+    hc_chunks, _, _ = _run_config(
+        HC_KEYS, HC_WIN_PER_BATCH, HC_BATCHES, repeats=REPEATS)
+    hc_st = _chunk_stats(hc_chunks)
+    hc_wps = hc_st["wps_mean"]
+    _log(f"{HC_KEYS} keys -> mean {hc_st['mean']:,.0f} t/s, "
+         f"{hc_wps:,.0f} win/s (mean)")
     # latency-optimized operating point: small batches span less stream
     # time per step (batch size is a per-op builder knob, as in the
     # reference). Both p99 figures are OPERATOR fire-to-delivery latency
     # (the sink consumes device batches directly); a CPU sink behind the
     # default depth-4 exit FIFO adds up to one watermark-punctuation
     # interval — set WF_EXIT_PIPELINE_DEPTH=0 for latency-sensitive exits.
-    _, _, lat_p99_us, _ = _run_config(N_KEYS, 64, 4, lat_batches=48,
-                                      batch_size=16384)
-    print(f"bench: p99 fire latency {p99_us:,.0f}us (64k batches) / "
-          f"{lat_p99_us:,.0f}us (16k batches)", file=sys.stderr)
+    _, lat_p99_us, _ = _run_config(N_KEYS, 64, 4, lat_batches=48,
+                                   batch_size=16384)
+    _log(f"p99 fire latency {p99_us:,.0f}us (64k batches) / "
+         f"{lat_p99_us:,.0f}us (16k batches)")
 
     # secondary device ops (one line each in the JSON extras)
     import jax.numpy as jnp
@@ -333,27 +480,35 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
                                          "value": a["value"] + b["value"]},
                            key_extractor="key", name="bench_kred"), 256, 12,
         repeats=REPEATS)
-    print(f"bench: stateful map {smap_tps:,.0f} t/s, "
-          f"keyed reduce {kred_tps:,.0f} t/s", file=sys.stderr)
+    _log(f"stateful map {smap_tps:,.0f} t/s, "
+         f"keyed reduce {kred_tps:,.0f} t/s")
 
     metric = "ffat_sliding_window_tuples_per_sec_per_chip"
     if fallback or platform == "cpu":
         metric += " (cpu-fallback)"
-    print(json.dumps({
+    result = {
         "metric": metric,
-        "value": round(tps, 1),
+        "value": round(st["mean"], 1),
         "unit": "tuples/sec",
-        "vs_baseline": round(tps / BASELINE_TUPLES_PER_SEC, 4),
+        "vs_baseline": round(st["mean"] / BASELINE_TUPLES_PER_SEC, 4),
+        "throughput_aggregation": f"mean-of-{REPEATS}-chunks",
+        "value_min": round(st["min"], 1),
+        "value_best": round(st["best"], 1),
+        "tuples_per_sec_16k_batches": round(st16["mean"], 1),
+        "vs_baseline_16k_batches": round(st16["mean"]
+                                         / BASELINE_TUPLES_PER_SEC, 4),
         "p99_window_fire_latency_us": round(p99_us, 1),
         "p99_window_fire_latency_us_latency_config": round(lat_p99_us, 1),
-        "throughput_aggregation": f"best-of-{REPEATS}-chunks",
         "windows_per_sec": round(wps, 1),
         "hc_keys": HC_KEYS,
-        "hc_tuples_per_sec": round(hc_tps, 1),
+        "hc_tuples_per_sec": round(hc_st["mean"], 1),
         "hc_windows_per_sec": round(hc_wps, 1),
         "stateful_map_tuples_per_sec": round(smap_tps, 1),
         "keyed_reduce_tuples_per_sec": round(kred_tps, 1),
-    }))
+    }
+    if platform == "tpu" and not fallback:
+        _persist_artifact(result, log_lines)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

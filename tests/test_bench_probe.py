@@ -1,18 +1,22 @@
 """bench.py probe discipline: probes are detached, never killed, retried
-with a deadline — the relay-safety contract PERF.md documents."""
+under one wall-clock budget — the relay-safety contract PERF.md
+documents — plus the session-artifact ingest path (round 3)."""
 
+import json
 import os
 import sys
+import time
 import types
 
 
-def _load_bench(monkeypatch, fake_popen):
+def _load_bench(monkeypatch, fake_popen=None):
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import importlib
     import bench
     importlib.reload(bench)
-    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    if fake_popen is not None:
+        monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     return bench
 
@@ -29,7 +33,7 @@ def test_probe_success(monkeypatch):
     assert bench._probe_backend() is True
 
 
-def test_probe_error_retries_then_gives_up(monkeypatch):
+def test_probe_error_retries_within_budget_then_gives_up(monkeypatch):
     calls = []
 
     class P:
@@ -40,12 +44,20 @@ def test_probe_error_retries_then_gives_up(monkeypatch):
             return 1  # UNAVAILABLE-style failure
 
     bench = _load_bench(monkeypatch, P)
-    monkeypatch.setenv("WF_BENCH_PROBE_ATTEMPTS", "3")
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "100")
+    monkeypatch.setenv("WF_BENCH_PROBE_BACKOFF", "20")
+    t = [0.0]
+
+    def mono():
+        t[0] += 5.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
     assert bench._probe_backend() is False
-    assert len(calls) == 3
+    assert len(calls) >= 2, "fast failures must retry within the budget"
 
 
-def test_probe_deadline_abandons_without_kill(monkeypatch):
+def test_probe_budget_abandons_without_kill(monkeypatch):
     killed = []
 
     class P:
@@ -61,8 +73,7 @@ def test_probe_deadline_abandons_without_kill(monkeypatch):
         terminate = kill
 
     bench = _load_bench(monkeypatch, P)
-    monkeypatch.setenv("WF_BENCH_PROBE_ATTEMPTS", "1")
-    monkeypatch.setenv("WF_BENCH_PROBE_DEADLINE", "0.05")
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "0.05")
     t = [0.0]
 
     def mono():
@@ -72,3 +83,89 @@ def test_probe_deadline_abandons_without_kill(monkeypatch):
     monkeypatch.setattr(bench.time, "monotonic", mono)
     assert bench._probe_backend() is False
     assert not killed, "probe must be abandoned, not killed"
+
+
+def test_probe_slow_claim_gets_whole_budget(monkeypatch):
+    """A slow HEALTHY claim (25-37 min observed) must not be cut off by a
+    short per-attempt deadline: one hanging probe is polled until the
+    overall budget runs out, and success inside it wins."""
+    polls = []
+
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            polls.append(1)
+            return 0 if len(polls) > 10 else None  # claims on 11th poll
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "1000")
+    t = [0.0]
+
+    def mono():
+        t[0] += 1.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is True
+
+
+# ---- ingest path -----------------------------------------------------
+
+
+def _write_artifact(bench, tmp_path, monkeypatch, **over):
+    art = {
+        "result": {"metric": "ffat_sliding_window_tuples_per_sec_per_chip",
+                   "value": 31e6, "unit": "tuples/sec", "vs_baseline": 1.03},
+        "platform": "tpu",
+        "measured_at_utc": "2026-07-29T16:00:00Z",
+        "measured_at_epoch": time.time() - 3600,
+        "git_sha": "cafebabe" * 5,
+        "raw_log": ["line1"],
+    }
+    art.update(over)
+    p = tmp_path / "bench_tpu_latest.json"
+    p.write_text(json.dumps(art))
+    monkeypatch.setattr(bench, "ARTIFACT", str(p))
+    return art
+
+
+def test_ingest_valid_artifact(monkeypatch, tmp_path, capsys):
+    bench = _load_bench(monkeypatch)
+    _write_artifact(bench, tmp_path, monkeypatch)
+    assert bench._try_ingest() is True
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["record"] == "ingested-from-session"
+    assert rec["vs_baseline"] == 1.03
+    assert "cpu-fallback" not in rec["metric"]
+    assert rec["git_sha_measured"].startswith("cafebabe")
+
+
+def test_ingest_rejects_stale_cpu_and_logless(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch)
+    _write_artifact(bench, tmp_path, monkeypatch,
+                    measured_at_epoch=time.time() - 90 * 3600)
+    assert bench._try_ingest() is False  # too old (24h default)
+
+    art = _write_artifact(bench, tmp_path, monkeypatch, platform="cpu")
+    assert bench._try_ingest() is False  # no tpu stamp
+
+    _write_artifact(bench, tmp_path, monkeypatch, raw_log=[])
+    assert bench._try_ingest() is False  # no raw log
+
+    _write_artifact(
+        bench, tmp_path, monkeypatch,
+        result={"metric": "x (cpu-fallback)", "value": 1.0})
+    assert bench._try_ingest() is False  # fallback result not ingestible
+
+
+def test_ingest_disabled_or_missing(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch)
+    monkeypatch.setattr(bench, "ARTIFACT",
+                        str(tmp_path / "does_not_exist.json"))
+    assert bench._try_ingest() is False
+    _write_artifact(bench, tmp_path, monkeypatch)
+    monkeypatch.setenv("WF_BENCH_INGEST_MAX_AGE_H", "0")
+    assert bench._try_ingest() is False

@@ -7,10 +7,19 @@ punctuations, flush/EOS) so rows never reorder and watermarks stay
 monotone."""
 
 import numpy as np
+import pytest
 
 from windflow_tpu.basic import ExecutionMode
 from windflow_tpu.tpu.batch import BatchTPU
 from windflow_tpu.tpu.schema import TupleSchema
+
+
+@pytest.fixture(autouse=True)
+def _no_age_bound(monkeypatch):
+    """These tests pin exact FIFO depth semantics; the wall-clock age
+    bound (WF_PIPELINE_MAX_AGE_MS) would evict heads during slow first
+    compiles, so disable it except where a test re-enables it."""
+    monkeypatch.setenv("WF_PIPELINE_MAX_AGE_MS", "0")
 
 
 class RecordingInner:
@@ -269,3 +278,24 @@ def test_split_fifo_routes_in_order():
     assert b0.rows == [0, 2, 10, 12, 20, 22]
     assert b1.rows == [1, 3, 11, 13, 21, 23]
     assert b0.flushed and b1.flushed
+
+
+def test_exit_fifo_age_bound_evicts_on_saturated_stream(monkeypatch):
+    """ADVICE r2: with punctuation disabled (non-DEFAULT modes) and a
+    saturated stream, queued batches must still be delivered within the
+    wall-clock age bound — _pipe_add itself evicts stale heads."""
+    import time
+
+    from windflow_tpu.tpu.emitters_tpu import TPUExitEmitter
+
+    monkeypatch.setenv("WF_PIPELINE_MAX_AGE_MS", "30")
+    inner = RecordingInner()
+    em = TPUExitEmitter(inner, depth=4)
+    em.emit_device_batch(_batch(0, wm=1))
+    em.emit_device_batch(_batch(10, wm=2))
+    time.sleep(0.05)  # both queued entries now exceed the 30 ms bound
+    # a third arrival (stream still saturated, no punctuation, no idle
+    # tick) must push the stale heads out even though depth=4 allows more
+    em.emit_device_batch(_batch(20, wm=3))
+    delivered = [e[1] for e in inner.events if e[0] == "row"]
+    assert delivered[:8] == [0, 1, 2, 3, 10, 11, 12, 13]

@@ -29,6 +29,8 @@ import math
 
 import numpy as np
 
+from ..tpu.schema import broadcast_scalar_fields
+
 
 def make_key_mesh(n_devices: int):
     """Largest 2D ('key', 'data') mesh for n devices (data axis >= 1)."""
@@ -258,7 +260,7 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
             ka, k_local, C, keys, panes, raw_vals)
 
         # ---- segmented scan by (key, pane) + leaf scatter-combine ------
-        vals = lift(recv_v)
+        vals = broadcast_scalar_fields(lift(recv_v), recv_k.shape[0])
         leaf = jnp.where(valid, recv_p % F, 0).astype(jnp.int32)
         big = jnp.int32(k_local * F)
         composite = jnp.where(valid, lkey * F + leaf, big)

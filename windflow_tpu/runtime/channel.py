@@ -16,6 +16,7 @@ backing transparently; the Python fallback keeps zero hard dependencies.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, List, Optional, Tuple
 
@@ -54,11 +55,23 @@ class Channel:
 
     def get(self, timeout: Optional[float] = None) -> Optional[Tuple[int, Any]]:
         """Blocking pop; with ``timeout`` (seconds) returns None if the
-        channel stays empty that long (the worker's idle tick)."""
+        channel stays empty that long (the worker's idle tick). The timeout
+        is a single deadline: spurious wakeups / raced notifies do not
+        restart it, so the idle tick is never delayed past ``timeout``."""
+        if timeout is None:
+            with self._not_empty:
+                while not self._q:
+                    self._not_empty.wait()
+                item = self._q.popleft()
+                self._not_full.notify()
+                return item
+        deadline = time.monotonic() + timeout
         with self._not_empty:
             while not self._q:
-                if not self._not_empty.wait(timeout) and not self._q:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     return None
+                self._not_empty.wait(remaining)
             item = self._q.popleft()
             self._not_full.notify()
             return item

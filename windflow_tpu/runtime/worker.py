@@ -70,15 +70,27 @@ class Worker(threading.Thread):
         idle_emitters = [em for node in self.chain
                          if (em := getattr(node, "emitter", None)) is not None
                          and hasattr(em, "on_idle")]
-        idle_ms = float(os.environ.get("WF_IDLE_DRAIN_MS", "50"))
+        try:
+            idle_ms = float(os.environ.get("WF_IDLE_DRAIN_MS", "50"))
+        except ValueError:
+            idle_ms = 50.0  # malformed knob must not take down the graph
         # <= 0 disables the tick (a 0 timeout would busy-spin when idle)
         idle_s = idle_ms / 1e3 if idle_emitters and idle_ms > 0 else None
+        # back off (up to 16x) when consecutive idle ticks find nothing to
+        # drain, so a fully idle graph doesn't wake every worker at 20 Hz
+        # on a small host; any real message resets the cadence
+        idle_streak = 0
         while self._eos_seen < n_inputs:
-            item = self.channel.get(idle_s)
+            backoff = idle_s if idle_s is None else idle_s * min(
+                16, 1 << min(idle_streak, 4))
+            item = self.channel.get(backoff)
             if item is None:  # idle tick
+                did_work = False
                 for em in idle_emitters:
-                    em.on_idle()
+                    did_work = bool(em.on_idle()) or did_work
+                idle_streak = 0 if did_work else idle_streak + 1
                 continue
+            idle_streak = 0
             ch, msg = item
             if isinstance(msg, EOS):
                 self._eos_seen += 1

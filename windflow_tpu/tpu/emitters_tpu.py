@@ -22,8 +22,9 @@
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -257,24 +258,43 @@ class _D2HPipeline:
                    depth: Optional[int] = None) -> None:
         self.depth = (depth if depth is not None
                       else int(os.environ.get(env_var, str(default))))
-        self._pending: "deque[BatchTPU]" = deque()
+        try:
+            age_ms = float(os.environ.get("WF_PIPELINE_MAX_AGE_MS", "100"))
+        except ValueError:
+            age_ms = 100.0
+        # wall-clock age bound: on a saturated stream with sparse output
+        # (and punctuation disabled outside DEFAULT mode) the idle tick
+        # never fires, so _pipe_add itself evicts entries older than this.
+        # Depth interplay: the bound only binds at inter-batch intervals
+        # > age/depth (25 ms at the defaults), where the ~70 ms async D2H
+        # of any entry older than 100 ms has already completed — eviction
+        # then is a cheap consume, not a sync-fetch stall
+        self._max_age_s = age_ms / 1e3 if age_ms > 0 else None
+        self._pending: "deque[Tuple[float, BatchTPU]]" = deque()
 
     def _pipe_process(self, batch: BatchTPU) -> None:
         raise NotImplementedError
 
     def _pipe_add(self, batch: BatchTPU) -> None:
-        self._pending.append(batch)
+        self._pending.append((time.monotonic(), batch))
         while len(self._pending) > self.depth:
-            self._pipe_process(self._pending.popleft())
+            self._pipe_process(self._pending.popleft()[1])
+        if self._max_age_s is not None:
+            horizon = time.monotonic() - self._max_age_s
+            while self._pending and self._pending[0][0] < horizon:
+                self._pipe_process(self._pending.popleft()[1])
 
     def _drain(self) -> None:
         while self._pending:
-            self._pipe_process(self._pending.popleft())
+            self._pipe_process(self._pending.popleft()[1])
 
-    def on_idle(self) -> None:
+    def on_idle(self) -> bool:
         """Worker idle tick: deliver queued batches — an idle stream must
-        not withhold already-computed results (Worker._process)."""
+        not withhold already-computed results (Worker._process). Returns
+        whether anything was drained (drives the worker's idle backoff)."""
+        had = bool(self._pending)
         self._drain()
+        return had
 
 
 _HASH_MODULUS = (1 << 61) - 1  # CPython hash(n) == n iff 0 <= n < 2^61-1
@@ -473,14 +493,16 @@ class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
             batch.prefetch_host()  # callable logic reads every column
         self._pipe_add(batch)
 
-    def on_idle(self) -> None:
+    def on_idle(self) -> bool:
         # drain our routing FIFO, then the branch emitters' own FIFOs
         # (a TPU->CPU branch nests a TPUExitEmitter the worker can't see)
+        did = bool(self._pending)
         self._drain()
         for e in self.inner:
             f = getattr(e, "on_idle", None)
             if f is not None:
-                f()
+                did = bool(f()) or did
+        return did
 
     def propagate_punctuation(self, wm: int) -> None:
         self._drain()

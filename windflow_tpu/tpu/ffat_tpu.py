@@ -53,7 +53,7 @@ import numpy as np
 from ..basic import OpType, RoutingMode, WinType, WindFlowError
 from .batch import BatchTPU, bucket_capacity
 from .ops_tpu import TPUOperatorBase, TPUReplicaBase
-from .schema import TupleSchema
+from .schema import TupleSchema, broadcast_scalar_fields
 
 class Ffat_Windows_TPU(TPUOperatorBase):
     op_type = OpType.WIN_TPU
@@ -322,7 +322,8 @@ class FfatTPUReplica(TPUReplicaBase):
             # padding lanes) in the narrowest int dtype — a third of the
             # transfer volume of separate slot/leaf/live arrays, which
             # matters when the chip sits behind a network tunnel.
-            vals = lift(fields)
+            vals = broadcast_scalar_fields(
+                lift(fields), next(iter(fields.values())).shape[0])
             if host_seg:
                 order = h_order
                 same_prev = h_same

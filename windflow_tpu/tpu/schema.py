@@ -158,3 +158,17 @@ class TupleSchema:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"TupleSchema({self.fields})"
+
+
+def broadcast_scalar_fields(vals: Any, n_rows: int) -> Any:
+    """Broadcast per-tuple CONSTANT lift fields (e.g. a count seed
+    ``{"n": 1.0}`` — per-row semantics in the reference's lift functor,
+    ``wf/ffat_windows.hpp``) to the batch column shape. Shared by the
+    single-chip FFAT step and the sharded-forest step so the lift-shape
+    rule cannot diverge between them."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: (jnp.broadcast_to(jnp.asarray(a), (n_rows,))
+                   if jnp.ndim(a) == 0 else a), vals)
