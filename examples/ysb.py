@@ -38,6 +38,8 @@ USE_TPU = os.environ.get("YSB_CPU") != "1"
 # filter/join/window chain is XLA programs over columnar batches.
 DEVICE_CHAIN = USE_TPU and os.environ.get("YSB_DEVICE_CHAIN") == "1"
 BATCH = int(os.environ.get("YSB_BATCH", "4096"))
+TS_STEP_US = 100  # event-time spacing in fill_broker; rate pacing derives the
+                  # event index from it (keep the two in sync)
 N_CAMPAIGNS = 100
 ADS_PER_CAMPAIGN = 10
 WIN_US = 10_000_000  # 10s tumbling windows
@@ -65,7 +67,7 @@ def fill_broker(n_events: int) -> None:
         b.produce("ad_events", {
             "ad_id": i % (N_CAMPAIGNS * ADS_PER_CAMPAIGN),
             "event_type": i % 3,
-            "ts": i * 100,
+            "ts": i * TS_STEP_US,
         }, key=i % 8)
 
 
@@ -80,10 +82,21 @@ def main(n_events: int = 60_000) -> None:
     def now_rel() -> int:
         return int((time.perf_counter() - wall0) * 1e6)
 
+    # YSB_RATE=<events/sec> paces ingestion to a fixed aggregate rate (the
+    # standard YSB latency protocol measures AT a rate, not at saturation
+    # where latency is just queue depth); 0/unset drains flat out.
+    rate = float(os.environ.get("YSB_RATE", "0") or 0)
+
     def deser(msg, shipper):
         if msg is None:
             return False
         p = msg.payload
+        if rate > 0:
+            target_us = (p["ts"] / TS_STEP_US) / rate * 1e6  # index/rate
+            lag = target_us - now_rel()
+            while lag > 500:
+                time.sleep(min(0.005, lag / 1e6))
+                lag = target_us - now_rel()
         shipper.push_with_timestamp(
             AdEvent(p["ad_id"], p["event_type"], p["ts"], now_rel()),
             p["ts"])
@@ -155,7 +168,7 @@ def main(n_events: int = 60_000) -> None:
     for i in range(n_events):
         if i % 3 == 0:
             c = (i % (N_CAMPAIGNS * ADS_PER_CAMPAIGN)) // ADS_PER_CAMPAIGN
-            w = (i * 100) // WIN_US
+            w = (i * TS_STEP_US) // WIN_US
             expected[(c, w)] = expected.get((c, w), 0) + 1
     ok = results == expected
     import math

@@ -29,10 +29,17 @@ echo "microbench rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 timeout 1200 python scripts/profile_tpu.py > "$L/profile.log" 2>&1
 echo "profile rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 
-# 4. YSB steady state on the chip, both chain modes
+# 4. YSB steady state on the chip, both chain modes + rate-paced latency
 timeout 1200 python examples/ysb.py 300000 > "$L/ysb.log" 2>&1
 echo "ysb rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 timeout 1200 env YSB_DEVICE_CHAIN=1 python examples/ysb.py 300000 \
     > "$L/ysb_chain.log" 2>&1
 echo "ysb_chain rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+# rate-paced latency protocol (VERDICT r2 item 4): fixed 100k ev/s
+timeout 900 env YSB_RATE=100000 python examples/ysb.py 300000 \
+    > "$L/ysb_rate100k.log" 2>&1
+echo "ysb_rate100k rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+timeout 900 env YSB_RATE=100000 YSB_CPU=1 python examples/ysb.py 300000 \
+    > "$L/ysb_rate100k_cpu.log" 2>&1
+echo "ysb_rate100k_cpu rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 echo "=== session done $(date -u +%H:%M:%S) ===" | tee -a "$L/status"

@@ -20,24 +20,37 @@ RUNS = 6
 
 def build_and_run(mode, rng, acc, chain=False):
     graph = PipeGraph("test_graph", mode, TimePolicy.INGRESS_TIME)
+    p_src, p_map, p_filt, p_sink = (rand_degree(rng) for _ in range(4))
     src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
-           .with_parallelism(rand_degree(rng))
+           .with_parallelism(p_src)
            .with_output_batch_size(rand_batch(rng))
            .build())
     mp = graph.add_source(src)
     map_op = (Map_Builder(lambda t: TupleT(t.key, t.value * 2, t.ts))
-              .with_parallelism(rand_degree(rng))
+              .with_parallelism(p_map)
               .with_output_batch_size(rand_batch(rng))
               .build())
     mp = mp.chain(map_op) if chain else mp.add(map_op)
     filt = (Filter_Builder(lambda t: t.value % 3 != 0)
-            .with_parallelism(rand_degree(rng))
+            .with_parallelism(p_filt)
             .with_output_batch_size(rand_batch(rng))
             .build())
     mp = mp.chain(filt) if chain else mp.add(filt)
-    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(rand_degree(rng)).build()
+    sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(p_sink).build()
     mp.add_sink(sink)
     graph.run()
+    # topology-shape assertion (reference asserts getNumThreads() per
+    # randomized configuration, tests/graph_tests_gpu/test_graph_gpu_1.cpp:
+    # 122-191): one worker per stage replica; chain() fuses an operator
+    # into the tail stage iff FORWARD + equal parallelism
+    stage_pars = [p_src]
+    for p in (p_map, p_filt):
+        if chain and p == stage_pars[-1]:
+            continue  # fused into the tail stage's workers
+        stage_pars.append(p)
+    stage_pars.append(p_sink)  # add_sink never fuses
+    assert graph.get_num_threads() == sum(stage_pars), (
+        chain, (p_src, p_map, p_filt, p_sink), graph.get_num_threads())
     return graph
 
 

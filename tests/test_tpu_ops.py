@@ -33,13 +33,18 @@ def test_source_map_tpu_sink():
         src = (Source_Builder(make_ingress_source(N_KEYS, STREAM_LEN))
                .with_parallelism(rand_degree(rng))
                .with_output_batch_size(rng.choice([8, 16, 32])).build())
+        p_map, p_sink = rand_degree(rng), rand_degree(rng)
         m = (Map_TPU_Builder(
                 lambda f: {**f, "value": f["value"] * 2 + f["key"]})
-             .with_parallelism(rand_degree(rng)).build())
+             .with_parallelism(p_map).build())
         sink = Sink_Builder(make_sum_sink(acc)).with_parallelism(
-            rand_degree(rng)).build()
+            p_sink).build()
         graph.add_source(src).add(m).add_sink(sink)
         graph.run()
+        # topology-shape assertion (reference test_graph_gpu_1.cpp:122-191):
+        # TPU ops never chain, so threads = sum of stage parallelisms
+        assert graph.get_num_threads() == \
+            src.parallelism + p_map + p_sink
         cur = (acc.value, acc.count)
         if last is None:
             last = cur
@@ -74,6 +79,9 @@ def test_map_filter_reduce_tpu_linear():
         sink = Sink_Builder(make_sum_sink(acc)).build()
         graph.add_source(src).add(m).add(flt).add(red).add_sink(sink)
         graph.run()
+        assert graph.get_num_threads() == (
+            src.parallelism + m.parallelism + flt.parallelism
+            + red.parallelism + sink.parallelism)
         cur = acc.value
         if last is None:
             last = cur

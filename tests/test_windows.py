@@ -262,3 +262,113 @@ def test_paned_cb_rejected_in_default_mode():
         Sink_Builder(lambda r: None).build())
     with pytest.raises(WindFlowError):
         graph.run()
+
+
+# ---------------------------------------------------------------------------
+# Reference-compat TB numbering: with_tb_origin (wf/window_replica.hpp:253-283)
+# ---------------------------------------------------------------------------
+def sum_win_func(ws):
+    return sum(w.value for w in ws)
+
+
+def test_keyed_windows_tb_origin_compat():
+    """Reference semantics: windows are anchored at the time origin, and
+    every window between the origin and a key's first tuple fires with
+    the identity/empty value. Default (first-tuple anchoring) would skip
+    those windows entirely — PARITY.md §2.3 documents the divergence;
+    this opt-in flag reproduces the reference numbering exactly."""
+    START = 5_000  # every key's first tuple is far from the origin
+    coll = WinCollector()
+    graph = PipeGraph("tb_origin", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i in range(40):
+            ts = START + i * TS_STEP
+            for k in range(3):
+                shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    win = (Keyed_Windows_Builder(sum_win_func)
+           .with_key_by(lambda t: t.key)
+           .with_tb_windows(WIN_US, SLIDE_US)
+           .with_tb_origin(0)
+           .build())
+    graph.add_source(Source_Builder(src).build()) \
+         .add(win).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+
+    # reference model: windows from the ORIGIN, w covers [w*slide,
+    # w*slide+win); windows fully before START are EMPTY (identity sum 0)
+    seqs = {k: [(i + 1 + k, START + i * TS_STEP) for i in range(40)]
+            for k in range(3)}
+    max_ts = START + 39 * TS_STEP
+    expected = {}
+    w = 0
+    while w * SLIDE_US <= max_ts:
+        lo, hi = w * SLIDE_US, w * SLIDE_US + WIN_US
+        for k in range(3):
+            expected[(k, w)] = sum(v for v, ts in seqs[k] if lo <= ts < hi)
+        w += 1
+    assert coll.dups == 0
+    assert coll.results == expected
+    # the empty origin-side windows really exist and are identity-valued
+    assert expected[(0, 0)] == 0 and coll.results[(0, 0)] == 0
+    n_empty = sum(1 for v in coll.results.values() if v == 0)
+    assert n_empty >= 3 * (START // SLIDE_US - 2)
+
+
+def test_keyed_windows_tb_default_skips_origin_windows():
+    """Counter-check: WITHOUT the flag, a key's numbering starts at its
+    first tuple — no empty origin-side windows fire."""
+    START = 5_000
+    coll = WinCollector()
+    graph = PipeGraph("tb_default", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i in range(40):
+            ts = START + i * TS_STEP
+            shipper.push_with_timestamp(TupleT(0, i + 1, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    win = (Keyed_Windows_Builder(sum_win_func)
+           .with_key_by(lambda t: t.key)
+           .with_tb_windows(WIN_US, SLIDE_US)
+           .build())
+    graph.add_source(Source_Builder(src).build()) \
+         .add(win).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    assert all(v > 0 for v in coll.results.values())
+    assert min(w for (_, w) in coll.results) >= (START - WIN_US) // SLIDE_US
+
+
+def test_paned_windows_tb_origin_compat():
+    """The origin flag flows through the composite (PLQ/WLQ) expansion."""
+    START = 4_000
+    coll = WinCollector()
+    graph = PipeGraph("paned_origin", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for i in range(40):
+            ts = START + i * TS_STEP
+            shipper.push_with_timestamp(TupleT(0, i + 1, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    win = (Paned_Windows_Builder(sum_win_func, lambda vals: sum(vals))
+           .with_key_by(lambda t: t.key)
+           .with_tb_windows(WIN_US, SLIDE_US)
+           .with_tb_origin(0)
+           .with_parallelism(2, 2)
+           .build())
+    graph.add_source(Source_Builder(src).build()) \
+         .add(win).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    # origin-side windows exist (empty -> identity sum 0)
+    assert (0, 0) in coll.results and coll.results[(0, 0)] == 0
+    # and a data-bearing window is exact
+    w_data = (START // SLIDE_US) + 1
+    lo, hi = w_data * SLIDE_US, w_data * SLIDE_US + WIN_US
+    exp = sum(i + 1 for i in range(40) if lo <= START + i * TS_STEP < hi)
+    assert coll.results[(0, w_data)] == exp

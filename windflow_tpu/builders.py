@@ -173,6 +173,7 @@ class _WindowedBuilder(BasicBuilder):
         self._lateness = 0
         self._incremental = False
         self._initial = None
+        self._tb_origin = None
 
     def with_key_by(self, key_extractor):
         self._key_extractor = key_extractor
@@ -192,6 +193,17 @@ class _WindowedBuilder(BasicBuilder):
         self._lateness = lateness_usec
         return self
 
+    def with_tb_origin(self, origin_usec: int = 0):
+        """Reference-compat TB window numbering
+        (``wf/window_replica.hpp:253-283``): anchor every key's windows at
+        this time origin and fire identity-valued EMPTY windows between
+        the origin and the key's first tuple as the watermark passes them.
+        Default (not called): a key's first window aligns to its first
+        tuple (PARITY.md §2.3) — epoch-scale timestamps would otherwise
+        create ~ts/slide empty windows, which this origin bounds."""
+        self._tb_origin = origin_usec
+        return self
+
     def incremental(self, initial_value=None):
         """Switch the window function to incremental form
         ``func(tuple, acc) -> acc``; ``initial_value`` may be a value
@@ -204,6 +216,10 @@ class _WindowedBuilder(BasicBuilder):
         if self._win_type is None:
             raise WindFlowError(f"{what}: call with_cb_windows() or "
                                 "with_tb_windows() first")
+        if self._tb_origin is not None and self._win_type is not WinType.TB:
+            raise WindFlowError(f"{what}: with_tb_origin applies to "
+                                "time-based windows only (the origin is a "
+                                "timestamp; CB windows count arrivals)")
 
 
 class Keyed_Windows_Builder(_WindowedBuilder):
@@ -216,7 +232,8 @@ class Keyed_Windows_Builder(_WindowedBuilder):
         return self._finish(Keyed_Windows(
             self._func, self._key_extractor, self._win_len, self._slide_len,
             self._win_type, self._lateness, self._incremental, self._initial,
-            self._name, self._parallelism, self._output_batch_size))
+            self._name, self._parallelism, self._output_batch_size,
+            tb_origin=self._tb_origin))
 
 
 class Parallel_Windows_Builder(_WindowedBuilder):
@@ -229,7 +246,8 @@ class Parallel_Windows_Builder(_WindowedBuilder):
         return self._finish(Parallel_Windows(
             self._func, self._key_extractor, self._win_len, self._slide_len,
             self._win_type, self._lateness, self._incremental, self._initial,
-            self._name, self._parallelism, self._output_batch_size))
+            self._name, self._parallelism, self._output_batch_size,
+            tb_origin=self._tb_origin))
 
 
 class _TwoStageWindowedBuilder(_WindowedBuilder):
@@ -263,7 +281,8 @@ class Paned_Windows_Builder(_TwoStageWindowedBuilder):
             self._slide_len, self._win_type, self._lateness,
             self._incremental, self._initial, self._incremental2,
             self._initial2, self._name, self._parallelism,
-            self._parallelism2, self._output_batch_size))
+            self._parallelism2, self._output_batch_size,
+            tb_origin=self._tb_origin))
 
 
 class MapReduce_Windows_Builder(_TwoStageWindowedBuilder):
@@ -278,7 +297,8 @@ class MapReduce_Windows_Builder(_TwoStageWindowedBuilder):
             self._slide_len, self._win_type, self._lateness,
             self._incremental, self._initial, self._incremental2,
             self._initial2, self._name, self._parallelism,
-            self._parallelism2, self._output_batch_size))
+            self._parallelism2, self._output_batch_size,
+            tb_origin=self._tb_origin))
 
 
 class Ffat_Windows_Builder(_WindowedBuilder):
@@ -300,6 +320,11 @@ class Ffat_Windows_Builder(_WindowedBuilder):
         self._check_windows("Ffat_Windows_Builder")
         if self._key_extractor is None:
             raise WindFlowError("Ffat_Windows_Builder: withKeyBy mandatory")
+        if self._tb_origin is not None:
+            raise WindFlowError(
+                "Ffat_Windows_Builder: with_tb_origin applies to the "
+                "window-engine operators (Keyed/Parallel/Paned/MapReduce "
+                "windows); the FFAT planes keep first-tuple anchoring")
         return self._finish(Ffat_Windows(
             self._func, self._combine, self._key_extractor, self._win_len,
             self._slide_len, self._win_type, self._lateness, self._name,

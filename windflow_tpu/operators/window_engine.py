@@ -98,7 +98,8 @@ class WindowEngine:
                  map_index: int = 0,
                  execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
                  riched: bool = False,
-                 context: Any = None) -> None:
+                 context: Any = None,
+                 tb_origin: Optional[int] = None) -> None:
         assert win_len > 0 and slide_len > 0
         self.win_type = win_type
         self.win_len = win_len
@@ -121,6 +122,14 @@ class WindowEngine:
         self.key_map: Dict[Any, _KeyDesc] = {}
         self.ignored_tuples = 0
         self.cur_wm = 0
+        # Reference-compat TB numbering (wf/window_replica.hpp:253-283):
+        # when set, a key's windows are anchored at this time origin (not
+        # its first tuple), and every window between the origin and the
+        # first tuple is created and fired with the identity/empty value.
+        # None (default) keeps the first-tuple anchoring documented in
+        # PARITY.md §2.3 (epoch-scale timestamps would otherwise create
+        # ~ts/slide empty windows — the origin bounds that blowup).
+        self.tb_origin = tb_origin if win_type is WinType.TB else None
 
     # ------------------------------------------------------------------
     def _first_gwid(self, key: Any) -> int:
@@ -148,7 +157,13 @@ class WindowEngine:
         index = ident if self.win_type is WinType.CB else ts
         first_gwid = self._first_gwid(key)
         initial = first_gwid * (self.slide_local // self.num_inner)
-        if is_new_key and self.win_type is WinType.TB:
+        if self.tb_origin is not None:
+            # reference-compat numbering: anchor every key's windows at
+            # the configured time origin; windows between the origin and
+            # the key's first tuple open below and fire empty (identity
+            # value) as the watermark passes them
+            initial += self.tb_origin
+        elif is_new_key and self.win_type is WinType.TB:
             # a key first seen at a large timestamp starts at the first
             # window that can contain it — creating (and empty-firing) every
             # window since the time origin would blow up with epoch-scale
@@ -159,7 +174,11 @@ class WindowEngine:
         min_boundary = (self.win_len + kd.last_fired_lwid * self.slide_local
                         if kd.last_fired_lwid >= 0 else 0)
         if index < initial + min_boundary:
-            if kd.last_fired_lwid >= 0:
+            # count real drops: fired-past tuples, and (origin mode) tuples
+            # before the configured origin — NOT pre-`initial` tuples that
+            # simply belong to another replica's windows (broadcast roles)
+            if kd.last_fired_lwid >= 0 or (self.tb_origin is not None
+                                           and index < self.tb_origin):
                 self.ignored_tuples += 1
             return
         # open every window whose range has been reached

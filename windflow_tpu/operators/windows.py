@@ -65,7 +65,8 @@ class _WindowOperatorBase(BasicOperator):
                  win_len: int, slide_len: int, win_type: WinType,
                  lateness: int, incremental: bool, initial_value: Any,
                  name: str, parallelism: int, input_routing: RoutingMode,
-                 output_batch_size: int, role: WinRole = WinRole.SEQ) -> None:
+                 output_batch_size: int, role: WinRole = WinRole.SEQ,
+                 tb_origin=None) -> None:
         if win_len <= 0 or slide_len <= 0:
             raise WindFlowError(f"{name}: window length and slide must be > 0")
         super().__init__(name, parallelism, input_routing, key_extractor,
@@ -78,6 +79,9 @@ class _WindowOperatorBase(BasicOperator):
         self.incremental = incremental
         self.initial_value = initial_value
         self.role = role
+        # reference-compat TB numbering (wf/window_replica.hpp:253-283):
+        # origin-anchored windows with identity-valued empty fires
+        self.tb_origin = tb_origin
         n_args = arity(win_func)
         self._riched = n_args >= (3 if incremental else 2)
 
@@ -98,20 +102,20 @@ class Keyed_Windows(_WindowOperatorBase):
                  win_type: WinType = WinType.CB, lateness: int = 0,
                  incremental: bool = False, initial_value: Any = None,
                  name: str = "keyed_windows", parallelism: int = 1,
-                 output_batch_size: int = 0) -> None:
+                 output_batch_size: int = 0, tb_origin=None) -> None:
         if key_extractor is None:
             raise WindFlowError("Keyed_Windows requires a key extractor")
         super().__init__(win_func, key_extractor, win_len, slide_len, win_type,
                          lateness, incremental, initial_value, name,
                          parallelism, RoutingMode.KEYBY, output_batch_size,
-                         WinRole.SEQ)
+                         WinRole.SEQ, tb_origin)
 
     def _make_engine(self, idx: int, context) -> WindowEngine:
         return WindowEngine(self.win_type, self.win_len, self.slide_len,
                             self.lateness, self.key_extractor, self.win_func,
                             self.incremental, self.initial_value, WinRole.SEQ,
                             0, 1, 1, 0, self.execution_mode, self._riched,
-                            context)
+                            context, tb_origin=self.tb_origin)
 
 
 class Parallel_Windows(_WindowOperatorBase):
@@ -121,11 +125,11 @@ class Parallel_Windows(_WindowOperatorBase):
                  incremental: bool = False, initial_value: Any = None,
                  name: str = "parallel_windows", parallelism: int = 1,
                  output_batch_size: int = 0,
-                 role: WinRole = WinRole.SEQ) -> None:
+                 role: WinRole = WinRole.SEQ, tb_origin=None) -> None:
         super().__init__(win_func, key_extractor, win_len, slide_len, win_type,
                          lateness, incremental, initial_value, name,
                          parallelism, RoutingMode.BROADCAST, output_batch_size,
-                         role)
+                         role, tb_origin)
 
     def configure(self, execution_mode, time_policy) -> None:
         super().configure(execution_mode, time_policy)
@@ -150,12 +154,13 @@ class Parallel_Windows(_WindowOperatorBase):
                                 self.win_func, self.incremental,
                                 self.initial_value, WinRole.MAP, 0, 1,
                                 self.parallelism, idx, self.execution_mode,
-                                self._riched, context)
+                                self._riched, context,
+                                tb_origin=self.tb_origin)
         return WindowEngine(self.win_type, self.win_len, self.slide_len,
                             self.lateness, self.key_extractor, self.win_func,
                             self.incremental, self.initial_value, self.role,
                             idx, self.parallelism, 1, 0, self.execution_mode,
-                            self._riched, context)
+                            self._riched, context, tb_origin=self.tb_origin)
 
 
 def _wrap_stage2_func(user_func: Callable, incremental: bool) -> Callable:
@@ -212,14 +217,16 @@ class Paned_Windows(_CompositeWindows):
                  plq_incremental: bool = False, plq_initial: Any = None,
                  wlq_incremental: bool = False, wlq_initial: Any = None,
                  name: str = "paned_windows", plq_parallelism: int = 1,
-                 wlq_parallelism: int = 1, output_batch_size: int = 0) -> None:
+                 wlq_parallelism: int = 1, output_batch_size: int = 0,
+                 tb_origin=None) -> None:
         if win_len <= slide_len:
             raise WindFlowError("Paned_Windows requires sliding windows "
                                 "(win_len > slide_len)")
         pane = math.gcd(win_len, slide_len)
         plq = Parallel_Windows(plq_func, key_extractor, pane, pane, win_type,
                                lateness, plq_incremental, plq_initial,
-                               name + "_plq", plq_parallelism, 0, WinRole.PLQ)
+                               name + "_plq", plq_parallelism, 0, WinRole.PLQ,
+                               tb_origin)
         wlq = Parallel_Windows(_wrap_stage2_func(wlq_func, wlq_incremental),
                                _result_key, win_len // pane, slide_len // pane,
                                WinType.CB, 0, wlq_incremental, wlq_initial,
@@ -240,12 +247,12 @@ class MapReduce_Windows(_CompositeWindows):
                  reduce_incremental: bool = False, reduce_initial: Any = None,
                  name: str = "mapreduce_windows", map_parallelism: int = 1,
                  reduce_parallelism: int = 1,
-                 output_batch_size: int = 0) -> None:
+                 output_batch_size: int = 0, tb_origin=None) -> None:
         map_stage = Parallel_Windows(map_func, key_extractor, win_len,
                                      slide_len, win_type, lateness,
                                      map_incremental, map_initial,
                                      name + "_map", map_parallelism, 0,
-                                     WinRole.MAP)
+                                     WinRole.MAP, tb_origin)
         reduce_stage = Parallel_Windows(
             _wrap_stage2_func(reduce_func, reduce_incremental), _result_key,
             map_parallelism, map_parallelism, WinType.CB, 0,

@@ -55,6 +55,22 @@ class TPUStageEmitter(BasicEmitter):
         self._keys: List[list] = [[] for _ in range(n_bufs)]
         self._wms: List[int] = [0] * n_bufs
         self._rr = 0
+        # time-bounded staging (reference: the GPU keyby emitter flushes
+        # partial batches rather than parking them, keyby_emitter_gpu.hpp:
+        # 740): a partial batch older than this ships even though it is
+        # not full, so low-rate streams pay at most ~this much batching
+        # delay instead of the full fill time. Only binds when the batch
+        # fills SLOWER than the bound — saturated streams are unaffected.
+        # Partial batches keep the full capacity bucket: no new compiles.
+        # Default 25 ms: the YSB A/B (PERF.md) showed 5 ms multiplies the
+        # program count enough to hurt BOTH latency and throughput when
+        # host and XLA share cores; 25 ms beat 0 and 5 on each metric.
+        try:
+            age_ms = float(os.environ.get("WF_MAX_STAGING_MS", "25"))
+        except ValueError:
+            age_ms = 25.0
+        self._stage_age_s = age_ms / 1e3 if age_ms > 0 else None
+        self._first_append: List[Optional[float]] = [None] * n_bufs
         # staging-buffer recycling over async H2D (reference
         # recycling_gpu.hpp per-emitter pools + in-transit counters)
         from ..recycling import ArrayPool, InFlightRecycler
@@ -78,14 +94,43 @@ class TPUStageEmitter(BasicEmitter):
                if self.key_extractor is not None else None)
         buf = (hash(key) % self.num_dests) if self.routing == "keyby" else 0
         rows = self._rows[buf]
-        if not rows or wm < self._wms[buf]:
+        if not rows:
+            self._wms[buf] = wm
+            if self._stage_age_s is not None:
+                self._first_append[buf] = time.monotonic()
+        elif wm < self._wms[buf]:
             self._wms[buf] = wm
         rows.append((payload, ts))
         if self.key_extractor is not None:
             self._keys[buf].append(key)
         if len(rows) >= self.output_batch_size:
             self._ship(buf)
+        if self._stage_age_s is not None:
+            # sweep EVERY buffer: under keyby routing a shifted key
+            # distribution must not park another buffer's partial batch
+            # past the bound (the idle tick never fires on a busy stream)
+            now = time.monotonic()
+            for b in range(len(self._rows)):
+                t0 = self._first_append[b]
+                if self._rows[b] and t0 is not None \
+                        and now - t0 >= self._stage_age_s:
+                    self._ship(b)
         self._maybe_generate_punctuation(wm)
+
+    def on_idle(self) -> bool:
+        """Worker idle tick: ship partial batches older than the staging
+        bound (a quiet stream must not park staged rows indefinitely)."""
+        if self._stage_age_s is None:
+            return False
+        now = time.monotonic()
+        did = False
+        for buf in range(len(self._rows)):
+            t0 = self._first_append[buf]
+            if self._rows[buf] and t0 is not None \
+                    and now - t0 >= self._stage_age_s:
+                self._ship(buf)
+                did = True
+        return did
 
     def _ship(self, buf: int) -> None:
         rows = self._rows[buf]
@@ -103,6 +148,7 @@ class TPUStageEmitter(BasicEmitter):
             self._update_pool_stats()
         self._rows[buf] = []
         self._keys[buf] = []
+        self._first_append[buf] = None
         if self.routing == "keyby":
             batch.id = self._next_ids[buf]
             self._next_ids[buf] += 1
