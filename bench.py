@@ -183,6 +183,12 @@ def _try_ingest() -> bool:
         sha_measured = str(art.get("git_sha", "unknown"))
         for line in art["raw_log"]:
             print(f"bench(session-log): {line}", file=sys.stderr)
+        # mark the METRIC NAME too: a consumer that reads only
+        # metric/value must not mistake a cached older-commit result for
+        # a fresh measurement of HEAD (the cpu-fallback path marks its
+        # metric the same way; provenance fields alone are ignorable)
+        result["metric"] = (result.get("metric", "")
+                            + " (ingested-from-session)")
         result.update({
             "record": "ingested-from-session",
             "measured_at_utc": measured_at,

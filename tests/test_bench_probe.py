@@ -140,6 +140,9 @@ def test_ingest_valid_artifact(monkeypatch, tmp_path, capsys):
     assert rec["record"] == "ingested-from-session"
     assert rec["vs_baseline"] == 1.03
     assert "cpu-fallback" not in rec["metric"]
+    # single-field consumers must see the provenance in the metric NAME:
+    # the value measured an older commit, not HEAD
+    assert "(ingested-from-session)" in rec["metric"]
     assert rec["git_sha_measured"].startswith("cafebabe")
 
 

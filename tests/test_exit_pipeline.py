@@ -322,6 +322,10 @@ def test_stage_emitter_ships_partial_on_age(monkeypatch):
     em.emit({"v": 2}, ts=1, wm=0)
     assert not sent  # far below the batch size, fresh
     _t.sleep(0.03)
+    # the in-emit sweep is AMORTIZED (every _SWEEP_EVERY rows — a
+    # per-row clock read is measurable on the hot path); force the
+    # countdown to fire on the next append
+    em._sweep_countdown = 1
     em.emit({"v": 3}, ts=2, wm=0)  # age exceeded -> ships all three
     assert len(sent) == 1 and sent[0].size == 3
     # idle tick path
@@ -330,3 +334,11 @@ def test_stage_emitter_ships_partial_on_age(monkeypatch):
     _t.sleep(0.03)
     assert em.on_idle() is True
     assert len(sent) == 2 and sent[1].size == 1
+    # amortized path without touching internals: _SWEEP_EVERY appends
+    # after the bound expires must ship the stale buffer mid-stream
+    for i in range(5, 5 + em._SWEEP_EVERY // 2):
+        em.emit({"v": i}, ts=i, wm=0)
+    _t.sleep(0.03)
+    for i in range(1000, 1000 + em._SWEEP_EVERY):
+        em.emit({"v": i}, ts=i, wm=0)
+    assert len(sent) == 3  # swept by the countdown, not by batch fill

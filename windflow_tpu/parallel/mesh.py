@@ -198,10 +198,12 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
       pytree of (1,)-arrays carrying the RAW tuple column dtypes
       (pre-lift);
     - ``step_fn(*state, keys, values, panes, frontier)`` (state is
-      SPLATTED) -> flat 9-tuple ``(trees, tvalid, next_fire, max_leaf,
-      fired, results, res_valid, res_wid, n_tuples)``; results have shape
-      (K_pad, fire_rounds) per lift field — window aggregates for each
-      owned key, up to ``fire_rounds`` windows per step;
+      SPLATTED) -> flat 10-tuple ``(trees, tvalid, next_fire, max_leaf,
+      fired, results, res_valid, res_wid, n_tuples, n_late)``; results
+      have shape (K_pad, fire_rounds) per lift field — window aggregates
+      for each owned key, up to ``fire_rounds`` windows per step;
+      ``n_late`` counts tuples dropped by the per-key lateness rule
+      (pane < next_fire[key]: every window containing it already fired);
     - ``meta = (K_pad, k_local, global_batch)``.
     """
     import jax
@@ -217,8 +219,11 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
                          f"(got {da})")
     K_pad = math.ceil(n_keys / ka) * ka
     k_local = K_pad // ka
+    # default ring: big enough for the window PLUS the worst-case unfired
+    # backlog one step can leave (fire_rounds windows of slide panes each)
+    # — an all-defaults config must satisfy the validation below
     F = ring_panes or (1 << max(3, math.ceil(
-        math.log2(win_panes + max(2 * slide_panes, 16)))))
+        math.log2(win_panes + max(fire_rounds * slide_panes, 16)))))
     if F & (F - 1) or F < win_panes + fire_rounds * slide_panes:
         raise ValueError(
             f"sharded_ffat_forest: ring_panes must be a power of two >= "
@@ -269,9 +274,38 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
 
     def local_step(trees, tvalid, next_fire, max_leaf, fired,
                    keys, raw_vals, panes, frontier):
+        # ---- fast-forward DRAINED keys past the frontier ----------------
+        # A key with max_leaf < next_fire holds no live leaves (everything
+        # below next_fire is evicted) and its pending windows are provably
+        # empty — but while it sits idle the frontier keeps moving, and on
+        # resume a new pane p >= next_fire + F would alias the ring slots
+        # its stalled windows still read: they would fire valid=True with
+        # the NEW tuple's value, and the per-round eviction would destroy
+        # the new leaf before its real window fires. Jump next_fire to the
+        # first slide-aligned start that is not yet fireable (skipping
+        # only empty windows); ``fired`` tracks next_fire//slide (origin
+        # numbering) and jumps with it. This makes the host's ring-headroom
+        # floor a real invariant for idle-resume keys.
+        first_unfireable = jnp.maximum(
+            jnp.int32(0),
+            ((frontier - win_panes) // slide_panes + 1) * slide_panes
+        ).astype(jnp.int32)
+        ff = (max_leaf < next_fire) & (next_fire < first_unfireable)
+        next_fire = jnp.where(ff, first_unfireable, next_fire)
+        fired = jnp.where(ff, first_unfireable // slide_panes, fired)
+
         # ---- route tuples to their key-owner shard (ICI all_to_all) ----
         recv_k, recv_p, recv_v, valid, lkey = _route_to_owners(
             ka, k_local, C, keys, panes, raw_vals)
+        # the reference's lateness rule, EXACT and per key
+        # (``wf/window_replica.hpp:258-268``: drop only tuples behind the
+        # last FIRED window): a pane is late iff every window containing
+        # it has fired, i.e. p < next_fire[key]. Late panes must also not
+        # touch the forest — their leaf slot may alias an evicted ring
+        # position. Counted and returned so the host can account drops.
+        late = valid & (recv_p < next_fire[lkey])
+        valid = valid & ~late
+        n_late = lax.psum(jnp.sum(late), ("key", "data"))
 
         # ---- segmented scan by (key, pane) + leaf scatter-combine ------
         vals = broadcast_scalar_fields(lift(recv_v), recv_k.shape[0])
@@ -388,7 +422,7 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
              res_wid))
         n_tuples = lax.psum(jnp.sum(valid), ("key", "data"))
         return (trees, tvalid, next_fire, max_leaf, fired,
-                res, res_valid, res_wid, n_tuples)
+                res, res_valid, res_wid, n_tuples, n_late)
 
     def init_fn(sample_vals):
         """sample_vals: pytree of (1,) arrays with the RAW tuple column
@@ -414,7 +448,8 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
                   P()),
         out_specs=(P("key", None), P("key", None), P("key"), P("key"),
                    P("key"),
-                   P("key", None), P("key", None), P("key", None), P()),
+                   P("key", None), P("key", None), P("key", None), P(),
+                   P()),
         # the butterfly delta-merge makes state/results equal across the
         # 'data' axis, but the varying-axis type system cannot infer that
         # replication through a generic-combine reduction

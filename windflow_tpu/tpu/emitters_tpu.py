@@ -39,6 +39,8 @@ class TPUStageEmitter(BasicEmitter):
     """CPU->TPU staging. Routing: FORWARD round-robins full batches,
     KEYBY partitions rows by key hash, BROADCAST ships shared batches."""
 
+    _SWEEP_EVERY = 256  # appended rows between staging-age sweeps
+
     def __init__(self, num_dests: int, output_batch_size: int,
                  schema: Optional[TupleSchema],
                  key_extractor: Optional[Callable],
@@ -71,6 +73,9 @@ class TPUStageEmitter(BasicEmitter):
             age_ms = 25.0
         self._stage_age_s = age_ms / 1e3 if age_ms > 0 else None
         self._first_append: List[Optional[float]] = [None] * n_bufs
+        self._sweep_every = self._SWEEP_EVERY
+        self._sweep_countdown = 1  # first append reads the clock, then adapts
+        self._last_sweep = time.monotonic()
         # staging-buffer recycling over async H2D (reference
         # recycling_gpu.hpp per-emitter pools + in-transit counters)
         from ..recycling import ArrayPool, InFlightRecycler
@@ -108,13 +113,32 @@ class TPUStageEmitter(BasicEmitter):
         if self._stage_age_s is not None:
             # sweep EVERY buffer: under keyby routing a shifted key
             # distribution must not park another buffer's partial batch
-            # past the bound (the idle tick never fires on a busy stream)
-            now = time.monotonic()
-            for b in range(len(self._rows)):
-                t0 = self._first_append[b]
-                if self._rows[b] and t0 is not None \
-                        and now - t0 >= self._stage_age_s:
-                    self._ship(b)
+            # past the bound (the idle tick never fires on a busy stream).
+            # AMORTIZED with a rate-ADAPTIVE cadence — a per-row
+            # monotonic() + O(num_dests) loop is measurable at tens of
+            # millions of rows/sec, but a fixed row count would let a
+            # saturated-but-SLOW stream (queue never empty, so no idle
+            # ticks) overshoot the bound by rows_per_sweep/rate. Each
+            # sweep re-targets ~[age/8, age/2] between sweeps: fast
+            # streams settle at the 256-row cap (clock read every ~µs
+            # of work), slow ones walk down toward per-row checks,
+            # where the clock read is negligible at their rate.
+            self._sweep_countdown -= 1
+            if self._sweep_countdown <= 0:
+                now = time.monotonic()
+                dt = now - self._last_sweep
+                self._last_sweep = now
+                if dt > self._stage_age_s / 2:
+                    self._sweep_every = max(1, self._sweep_every // 8)
+                elif dt < self._stage_age_s / 8:
+                    self._sweep_every = min(self._SWEEP_EVERY,
+                                            self._sweep_every * 2)
+                self._sweep_countdown = self._sweep_every
+                for b in range(len(self._rows)):
+                    t0 = self._first_append[b]
+                    if self._rows[b] and t0 is not None \
+                            and now - t0 >= self._stage_age_s:
+                        self._ship(b)
         self._maybe_generate_punctuation(wm)
 
     def on_idle(self) -> bool:

@@ -22,13 +22,24 @@ Design (vs the single-chip ``tpu/ffat_tpu.py``):
   fire with ``valid=False`` — the reference's TB numbering
   (``wf/window_replica.hpp:253-283``), NOT the single-chip plane's
   first-tuple anchoring (PARITY.md §2.3 documents that divergence);
-- keys must be integers in ``[0, key_capacity)``: block ownership means
-  global state row k IS key k (shard ``s`` owns ``[s*k_local,
-  (s+1)*k_local)``). Arbitrary key domains belong on the single-chip
-  operator, which hashes through a host ``KeySlotMap``;
-- tuples whose pane is behind the fire frontier are DROPPED and counted
-  ignored (the reference's lateness rule; feeding them would alias the
-  circular leaf ring), and tuples more than ``ring - win`` panes AHEAD of
+- keys may be ARBITRARY integers (any int64, sparse or negative): a host
+  ``KeySlotMap`` assigns each distinct key a dense slot in
+  ``[0, key_capacity)`` in first-seen order — the same dictionary the
+  single-chip plane routes through — and the slot feeds the block-owner
+  mapping (shard ``s`` owns slots ``[s*k_local, (s+1)*k_local)``); fired
+  windows carry the ORIGINAL key. More distinct keys than
+  ``key_capacity`` raise loudly (``with_key_capacity`` is the knob).
+  Non-integer key types stay single-chip-only: their per-row Python
+  hashing would serialize the mesh's host control loop;
+- lateness is the reference's EXACT per-key rule, enforced on device: a
+  tuple is dropped (and counted ignored) iff every window containing its
+  pane has already fired for its key — ``pane < next_fire[key]``
+  (``wf/window_replica.hpp:258-268``); the only host-side drop is panes
+  below the first batch's slide-aligned rebase anchor, which the device
+  pane domain cannot represent. Keys that go idle are fast-forwarded past
+  the frontier inside the step (their skipped windows are provably
+  empty), so an idle-resume key can never read aliased ring leaves; and
+  tuples more than ``ring - win`` panes AHEAD of
   the frontier raise loudly — size the ring via ``with_mesh(ring_panes=)``
   for sources that outrun their watermarks.
 
@@ -125,6 +136,18 @@ class FfatMeshReplica(TPUReplicaBase):
         # advanced minus fire_rounds per step): eviction lags firing, so
         # ring-aliasing safety must account for it (see _maybe_catch_up)
         self._backlog_bound = 0
+        # arbitrary int keys -> dense slots [0, key_capacity) in
+        # first-seen order; fired windows map slots back to originals
+        from .keymap import KeySlotMap
+        self._key_by_slot = np.zeros(op.key_capacity, np.int64)
+        self._keymap = KeySlotMap(on_new=self._on_new_key)
+
+    def _on_new_key(self, key, slot: int) -> None:
+        if slot >= self.op.key_capacity:
+            raise WindFlowError(
+                f"{self.op.name}: distinct key count exceeds key_capacity="
+                f"{self.op.key_capacity}; raise with_key_capacity")
+        self._key_by_slot[slot] = key
 
     # -- lazy mesh/program construction ---------------------------------
     def _ensure(self, batch: BatchTPU) -> None:
@@ -142,16 +165,21 @@ class FfatMeshReplica(TPUReplicaBase):
         da = self._mesh.shape["data"]
         local_batch = op.local_batch or max(
             1, math.ceil(batch.capacity / (ka * da)))
+        # keep in lockstep with sharded_ffat_forest's default: the ring
+        # must hold the window PLUS fire_rounds slides of unfired backlog
         self._F = op.ring_panes or (1 << max(3, math.ceil(math.log2(
-            self.win_units + max(2 * self.slide_units, 16)))))
+            self.win_units + max(op.fire_rounds * self.slide_units, 16)))))
         self._val_fields = list(batch.fields.keys())
         self._val_dtypes = {f: batch.schema.fields[f]
                             for f in self._val_fields}
-        init_fn, step, (K_pad, k_local, GB) = sharded_ffat_forest(
-            self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
-            win_panes=self.win_units, slide_panes=self.slide_units,
-            local_batch=local_batch, fire_rounds=op.fire_rounds,
-            ring_panes=self._F)
+        try:
+            init_fn, step, (K_pad, k_local, GB) = sharded_ffat_forest(
+                self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
+                win_panes=self.win_units, slide_panes=self.slide_units,
+                local_batch=local_batch, fire_rounds=op.fire_rounds,
+                ring_panes=self._F)
+        except ValueError as e:  # config validation -> framework error
+            raise WindFlowError(f"{op.name}: {e}") from None
         self._step = step
         self._GB, self._K_pad = GB, K_pad
         sample = {f: np.zeros(1, dt) for f, dt in self._val_dtypes.items()}
@@ -183,15 +211,12 @@ class FfatMeshReplica(TPUReplicaBase):
         keys = np.asarray(self.batch_keys(batch))[:n]
         if keys.dtype.kind not in "iu":
             raise WindFlowError(
-                f"{self.op.name}: mesh FFAT requires integer keys in "
-                f"[0, key_capacity); got dtype {keys.dtype}")
-        if n and (int(keys.min()) < 0
-                  or int(keys.max()) >= self.op.key_capacity):
-            # validate against the DECLARED capacity, not the mesh-padded
-            # K_pad — acceptance must not depend on the mesh shape
-            raise WindFlowError(
-                f"{self.op.name}: keys must lie in [0, key_capacity="
-                f"{self.op.key_capacity}); raise with_key_capacity")
+                f"{self.op.name}: mesh FFAT requires integer keys "
+                f"(sparse/negative int64 ok); got dtype {keys.dtype}")
+        # arbitrary int domain -> dense slots (the capacity guard lives
+        # in _on_new_key: it fires against the DECLARED capacity, not
+        # the mesh-padded K_pad — acceptance must not depend on shape)
+        keys = self._keymap.slots_of(keys, keys, n).astype(np.int64)
         panes = (batch.ts_host[:n] // self.op.pane_len).astype(np.int64)
         if self._pane_base is None:
             base = int(panes.min()) if n else 0
@@ -199,9 +224,12 @@ class FfatMeshReplica(TPUReplicaBase):
         panes = panes - self._pane_base
         # frontier: the single-chip convention ((wm - lateness) // pane)
         self._advance_frontier(self._rebased_frontier())
-        # lateness rule + ring safety: panes behind the frontier may alias
-        # evicted leaves (circular ring) -> drop and count ignored
-        live = panes >= self._frontier
+        # the EXACT lateness rule (drop iff behind the key's last fired
+        # window) lives ON DEVICE as a per-key mask against next_fire;
+        # the host only drops panes below the rebase anchor (the first
+        # batch's slide-aligned min pane — the device pane domain cannot
+        # represent them; counted ignored, a documented anchor divergence)
+        live = panes >= 0
         dropped = n - int(live.sum())
         if dropped:
             self.stats.inputs_ignored += dropped
@@ -254,14 +282,18 @@ class FfatMeshReplica(TPUReplicaBase):
                 "faster or raise with_mesh(ring_panes=...)")
 
     def _catch_up(self) -> None:
-        """Fire the backlog with data-less steps until the device control
-        state shows no window eligible at the current frontier."""
-        for _ in range(100_000):  # safety bound
-            nf = np.asarray(self._state[2])
-            ml = np.asarray(self._state[3])
-            eligible = (nf + self.win_units <= self._frontier) & (ml >= nf)
-            if not eligible.any():
-                break
+        """Fire the backlog with data-less steps. ONE control-state fetch
+        sizes the whole drain (per-iteration D2H costs ~70 ms fixed on the
+        tunnel): each key can fire ``min((frontier-win-nf)//slide,
+        (ml-nf)//slide) + 1`` windows — the device's own eligibility rule
+        — and every step fires up to fire_rounds of them per key."""
+        nf = np.asarray(self._state[2]).astype(np.int64)
+        ml = np.asarray(self._state[3]).astype(np.int64)
+        per_key = np.minimum(
+            (self._frontier - self.win_units - nf) // self.slide_units,
+            (ml - nf) // self.slide_units) + 1
+        n_win = int(np.maximum(per_key, 0).max(initial=0))
+        for _ in range(-(-n_win // self.op.fire_rounds)):
             self._run_steps(np.zeros(0, np.int32), np.zeros(0, np.int32),
                             self._empty_vals())
         self._backlog_bound = 0
@@ -298,6 +330,9 @@ class FfatMeshReplica(TPUReplicaBase):
             self._backlog_bound = max(0,
                                       self._backlog_bound
                                       - self.op.fire_rounds)
+            n_late = int(out[9])
+            if n_late:
+                self.stats.inputs_ignored += n_late
             self._emit_fired(out[5], out[6], out[7])
             off = hi
             if off >= total:
@@ -305,24 +340,36 @@ class FfatMeshReplica(TPUReplicaBase):
 
     def _emit_fired(self, res, res_valid, res_wid) -> None:
         """Harvest the step's fired-window block (K_pad x fire_rounds —
-        small) and emit one row per fired window through the exit edge."""
+        small) and emit ONE columnar batch per step through the exit
+        edge, like the single-chip plane (``tpu/ffat_tpu.py`` emits one
+        ``BatchTPU`` per fire sweep): numpy gathers only, no per-window
+        Python loop. Rows carry ``valid`` — the aggregate fields of a
+        ``valid=False`` (empty-window) row are meaningless, matching the
+        single-chip plane's columnar contract."""
         rw = np.asarray(res_wid)
-        if not (rw >= 0).any():
+        fired = rw >= 0
+        n_out = int(fired.sum())
+        if not n_out:
             return
         rv = np.asarray(res_valid)
-        rvals = {f: np.asarray(res[f]) for f in self._out_fields}
         key_field = self.op.key_field or "key"
         wid_base = (self._pane_base or 0) // self.slide_units
-        krows, rounds = np.nonzero(rw >= 0)
-        for k, r in zip(krows.tolist(), rounds.tolist()):
-            wid = int(rw[k, r]) + wid_base  # global origin-anchored id
-            end_ts = (wid * self.slide_units + self.win_units) \
-                * self.op.pane_len
-            row = {key_field: k, "wid": wid, "valid": bool(rv[k, r])}
-            for f in self._out_fields:
-                row[f] = rvals[f][k, r].item() if rv[k, r] else None
-            self.stats.outputs_sent += 1
-            self.emitter.emit(row, end_ts, self.cur_wm)
+        krows, rounds = np.nonzero(fired)
+        wids = rw[krows, rounds].astype(np.int64) + wid_base
+        end_ts = (wids * self.slide_units + self.win_units) \
+            * self.op.pane_len
+        fields: Dict[str, np.ndarray] = {
+            key_field: self._key_by_slot[krows],  # slots -> original keys
+            "wid": wids,
+            "valid": rv[krows, rounds],
+        }
+        for f in self._out_fields:
+            fields[f] = np.asarray(res[f])[krows, rounds]
+        schema = TupleSchema({name: np.dtype(col.dtype)
+                              for name, col in fields.items()})
+        out = BatchTPU(fields, end_ts, n_out, schema, self.cur_wm,
+                       host_keys=fields[key_field])
+        self._emit_batch(out)
 
     def flush_on_termination(self) -> None:
         """EOS: fire every remaining window that holds data (partial
@@ -331,12 +378,14 @@ class FfatMeshReplica(TPUReplicaBase):
         if self._step is None or self._max_pane_seen < 0:
             return
         self._advance_frontier(self._max_pane_seen + self.win_units + 1)
-        # each data-less step fires up to fire_rounds windows per key;
-        # loop until the control state shows nothing left to fire
-        for _ in range(10_000):  # safety bound
-            nf = np.asarray(self._state[2])  # next_fire
-            ml = np.asarray(self._state[3])  # max_leaf
-            if not (nf <= ml).any():
-                break
+        # ONE control-state fetch sizes the drain (no per-iteration D2H):
+        # with the frontier past every pane, key k has (ml-nf)//slide + 1
+        # windows left; each data-less step fires up to fire_rounds of
+        # them per key
+        nf = np.asarray(self._state[2]).astype(np.int64)  # next_fire
+        ml = np.asarray(self._state[3]).astype(np.int64)  # max_leaf
+        per_key = (ml - nf) // self.slide_units + 1
+        n_win = int(np.maximum(per_key, 0).max(initial=0))
+        for _ in range(-(-n_win // self.op.fire_rounds)):
             self._run_steps(np.zeros(0, np.int32), np.zeros(0, np.int32),
                             self._empty_vals())
