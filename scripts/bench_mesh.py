@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Mesh-plane benchmark: Ffat_Windows_Mesh throughput (round-4 verdict
+item 3 — "a multichip surface with no throughput number is architecture,
+not capability").
+
+Drives the FfatMeshReplica directly with pre-staged keyed batches (same
+protocol as bench.py's single-chip measurement: staging excluded, the
+metric is the sharded-operator path — all_to_all keyby over the mesh,
+segmented leaf combine, level rebuild, device-side fire rounds, columnar
+exit). On a CPU backend it forces the virtual 8-device mesh the test
+suite uses; on a real TPU it uses however many chips exist (n=1 today:
+the per-chip overhead of the mesh program, the number a multi-chip
+deployment would amortize).
+
+Prints ONE JSON line: tuples/s, windows/s, mesh shape, platform.
+"""
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+        or os.environ.get("WF_MESH_BENCH_CPU") == "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_KEYS = 64
+BATCH = 16384
+N_BATCHES = 12
+WARMUP = 3
+REPEATS = int(os.environ.get("WF_BENCH_REPEATS", "5"))
+WIN_US = 100_000
+SLIDE_US = 25_000
+TS_STEP = 50  # aggregate stream-time µs per tuple across all keys
+
+
+class _Sink:
+    def __init__(self):
+        self.windows = 0
+        self.last = None
+
+    def emit_device_batch(self, b):
+        self.windows += b.size
+        self.last = b
+
+    def set_stats(self, s):
+        pass
+
+    def propagate_punctuation(self, wm):
+        pass
+
+    def flush(self):
+        pass
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from windflow_tpu.basic import WinType
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.ffat_mesh import Ffat_Windows_Mesh
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+
+    op = Ffat_Windows_Mesh(
+        lift=lambda f: {"value": f["value"]},
+        combine=lambda a, b: {"value": a["value"] + b["value"]},
+        key_extractor="key", win_len=WIN_US, slide_len=SLIDE_US,
+        win_type=WinType.TB, key_capacity=N_KEYS, n_devices=n_dev,
+        name="bench_mesh")
+    op.build_replicas()
+    rep = op.replicas[0]
+    sink = _Sink()
+    rep.emitter = sink
+
+    schema = TupleSchema({"key": np.int32, "value": np.float32})
+    rng = np.random.default_rng(0)
+    batches = []
+    ts0 = 0
+    for _ in range(REPEATS * N_BATCHES + WARMUP):
+        keys = rng.integers(0, N_KEYS, BATCH)
+        ts = ts0 + np.arange(BATCH, dtype=np.int64) * TS_STEP // N_KEYS
+        ts0 = int(ts[-1]) + TS_STEP
+        b = BatchTPU(
+            {"key": keys.astype(np.int32),
+             "value": rng.random(BATCH).astype(np.float32)},
+            ts, BATCH, schema, wm=max(0, int(ts[0]) - 1000),
+            host_keys=keys)
+        b.wm = int(ts[-1])
+        batches.append(b)
+
+    for b in batches[:WARMUP]:
+        rep.handle_msg(0, b)
+    jax.block_until_ready(rep._state[0])
+
+    chunks = []
+    for r in range(REPEATS):
+        lo = WARMUP + r * N_BATCHES
+        w0 = sink.windows
+        t0 = time.perf_counter()
+        for b in batches[lo:lo + N_BATCHES]:
+            rep.handle_msg(0, b)
+        jax.block_until_ready(rep._state[0])
+        el = time.perf_counter() - t0
+        chunks.append((N_BATCHES * BATCH / el, (sink.windows - w0) / el))
+
+    tl = sorted(c[0] for c in chunks)
+    result = {
+        "metric": "mesh_ffat_tuples_per_sec"
+                  + ("" if platform == "tpu" else f" ({platform})"),
+        "value": round(sum(tl) / len(tl), 1),
+        "unit": "tuples/sec",
+        "value_min": round(tl[0], 1),
+        "value_best": round(tl[-1], 1),
+        "windows_per_sec": round(
+            sum(c[1] for c in chunks) / len(chunks), 1),
+        "mesh_shape": dict(rep._mesh.shape),
+        "global_batch": rep._GB,
+        "device_programs": rep.stats.device_programs_run,
+        "platform": platform,
+        "n_devices": n_dev,
+        "throughput_aggregation": f"mean-of-{REPEATS}-chunks",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

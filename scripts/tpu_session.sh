@@ -25,6 +25,12 @@ echo "seg_ab rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 timeout 900 python scripts/microbench.py > "$L/microbench.log" 2>&1
 echo "microbench rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
 
+# 2d. mesh-plane operator on the real chip (n_devices=1: per-chip
+# overhead of the sharded program, the number multi-chip amortizes)
+timeout 900 python scripts/bench_mesh.py > "$L/bench_mesh.log" 2>&1
+echo "bench_mesh rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"
+tail -1 "$L/bench_mesh.log" >> "$L/status"
+
 # 3. host/device split profile (for PERF.md)
 timeout 1200 python scripts/profile_tpu.py > "$L/profile.log" 2>&1
 echo "profile rc=$? $(date -u +%H:%M:%S)" | tee -a "$L/status"

@@ -1,0 +1,126 @@
+"""Non-int keyby routing (round-4 verdict item 6): str/bytes key columns
+route through a vectorized padding-invariant FNV instead of per-row
+Python ``hash()``; the per-row emit path uses a scalar twin so a stream
+mixing push()/push_columns() keeps every key on one replica; and the
+residual cliff for object keys is bounded by measurement."""
+
+import time
+
+import numpy as np
+
+from windflow_tpu.tpu.emitters_tpu import (TPUStageEmitter, _bytes_key_dests,
+                                           _dest_of_key)
+from windflow_tpu.tpu.schema import TupleSchema
+
+N_DESTS = 4
+
+# non-numeric keys cannot be DEVICE columns: the supported shape is an
+# explicit schema that OMITS the key field, with keys riding host
+# metadata (the single-chip FFAT's composite-key convention)
+VAL_SCHEMA = TupleSchema({"v": np.float32})
+
+
+class _Port:
+    def __init__(self):
+        self.batches = []
+
+    def send(self, b):
+        if getattr(b, "size", None) is not None:
+            self.batches.append(b)
+
+
+def _mk_emitter(obs=64):
+    em = TPUStageEmitter(N_DESTS, obs, VAL_SCHEMA, lambda t: t["k"],
+                         "keyby", key_field="k")
+    ports = [_Port() for _ in range(N_DESTS)]
+    em.set_ports(ports)
+    return em, ports
+
+
+def _dest_map(ports):
+    m = {}
+    for d, p in enumerate(ports):
+        for b in p.batches:
+            keys = (b.host_keys.tolist()
+                    if isinstance(b.host_keys, np.ndarray) else b.host_keys)
+            for k in keys:
+                k = k.decode() if isinstance(k, bytes) else str(k)
+                assert m.setdefault(k, d) == d, f"key {k!r} split across dests"
+                m[k] = d
+    return m
+
+
+def test_str_keys_rowwise_and_columnar_route_identically():
+    keys = [f"sym{i:03d}" for i in range(60)]
+    em1, ports1 = _mk_emitter()
+    for i, k in enumerate(keys * 3):
+        em1.emit({"k": k, "v": 1.0}, ts=i, wm=0)
+    em1.flush()
+    em2, ports2 = _mk_emitter()
+    cols = {"k": np.array(keys * 3), "v": np.ones(180, np.float32)}
+    em2.emit_columns(cols, np.arange(180, dtype=np.int64), wm=0)
+    em2.flush()
+    m1, m2 = _dest_map(ports1), _dest_map(ports2)
+    assert m1 == m2, "row-wise vs columnar routing diverged"
+    # sanity: the map actually spreads load
+    assert len(set(m1.values())) >= 2
+
+
+def test_bytes_key_routing_padding_invariant():
+    """The same key must route identically whatever fixed width the
+    column dtype happens to have (batches of one stream can infer
+    different widths)."""
+    ks = [b"a", b"abc", b"abcdef", b"zz"]
+    narrow = np.array(ks)                     # S6
+    wide = np.array(ks, dtype="S24")          # S24
+    assert (_bytes_key_dests(narrow, 4, N_DESTS)
+            == _bytes_key_dests(wide, 4, N_DESTS)).all()
+    # scalar twin agrees with the vectorized path
+    for k, d in zip(ks, _bytes_key_dests(narrow, 4, N_DESTS).tolist()):
+        assert _dest_of_key(k, N_DESTS) == d
+    # unicode column vs python str
+    us = np.array(["aé", "b∆c", "plain"])
+    for k, d in zip(us.tolist(), _bytes_key_dests(us, 3, N_DESTS).tolist()):
+        assert _dest_of_key(k, N_DESTS) == d
+    # byte-order invariance: a big-endian column (frombuffer/parquet)
+    # must route like native batches and the scalar path
+    be = us.astype(us.dtype.newbyteorder(">"))
+    assert (_bytes_key_dests(be, 3, N_DESTS)
+            == _bytes_key_dests(us, 3, N_DESTS)).all()
+    # empty chunk must not crash (zero-row push_columns poll result)
+    assert _bytes_key_dests(np.zeros(0, "U4"), 0, N_DESTS).size == 0
+
+
+def test_str_key_columnar_staging_cliff_bounded():
+    """The measured cliff: str-key columnar staging must stay within 3x
+    of int-key staging (~1.5x measured with the codepoint-lane FNV;
+    it was ~3.6x on the per-row-hash path this replaces). Object
+    (tuple) keys stay on the per-row path — measured and printed, not
+    bounded (they are the documented residual cliff, ~5-7x)."""
+    n = 1 << 15
+    rng = np.random.default_rng(0)
+    ints = rng.integers(0, 64, n)
+    strs = np.array([f"k{v:06d}" for v in range(64)])[ints]
+    vals = np.ones(n, np.float32)
+    ts = np.arange(n, dtype=np.int64)
+
+    def run(kcol):
+        em = TPUStageEmitter(N_DESTS, n, VAL_SCHEMA, lambda t: t["k"],
+                             "keyby", key_field="k")
+        em.set_ports([_Port() for _ in range(N_DESTS)])
+        t0 = time.perf_counter()
+        for _ in range(4):
+            em.emit_columns({"k": kcol, "v": vals}, ts, wm=0)
+        return 4 * n / (time.perf_counter() - t0)
+
+    run(ints)  # warm the jit/staging path once
+    int_tps = max(run(ints) for _ in range(3))
+    str_tps = max(run(strs) for _ in range(3))
+    objs = np.empty(n, object)
+    objs[:] = [(int(v), "x") for v in ints]
+    obj_tps = max(run(objs) for _ in range(3))
+    print(f"staging t/s: int={int_tps:,.0f} str={str_tps:,.0f} "
+          f"obj={obj_tps:,.0f} (str cliff {int_tps / str_tps:.2f}x, "
+          f"obj cliff {int_tps / obj_tps:.2f}x)")
+    assert str_tps * 3 >= int_tps, (
+        f"str-key staging cliff regressed: {int_tps / str_tps:.1f}x")

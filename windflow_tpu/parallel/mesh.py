@@ -32,6 +32,17 @@ import numpy as np
 from ..tpu.schema import broadcast_scalar_fields
 
 
+def default_ring_panes(win_panes: int, slide_panes: int,
+                       fire_rounds: int) -> int:
+    """Default leaf-ring size: the smallest power of two holding the
+    window PLUS the worst-case unfired backlog one step can leave
+    (fire_rounds windows of slide panes each) — the single definition
+    shared by the forest and the topology operator, so an all-defaults
+    config always satisfies the forest's validation."""
+    return 1 << max(3, math.ceil(
+        math.log2(win_panes + max(fire_rounds * slide_panes, 16))))
+
+
 def make_key_mesh(n_devices: int, shape=None):
     """Largest 2D ('key', 'data') mesh for n devices (data axis >= 1).
     ``shape=(ka, da)`` forces an explicit factorization (result invariance
@@ -219,11 +230,8 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
                          f"(got {da})")
     K_pad = math.ceil(n_keys / ka) * ka
     k_local = K_pad // ka
-    # default ring: big enough for the window PLUS the worst-case unfired
-    # backlog one step can leave (fire_rounds windows of slide panes each)
-    # — an all-defaults config must satisfy the validation below
-    F = ring_panes or (1 << max(3, math.ceil(
-        math.log2(win_panes + max(fire_rounds * slide_panes, 16)))))
+    F = ring_panes or default_ring_panes(win_panes, slide_panes,
+                                         fire_rounds)
     if F & (F - 1) or F < win_panes + fire_rounds * slide_panes:
         raise ValueError(
             f"sharded_ffat_forest: ring_panes must be a power of two >= "

@@ -144,7 +144,10 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         (keyby via ``lax.all_to_all`` over ICI, on-device fire control)
         instead of the single-chip plane. ``mesh_shape=(ka, da)`` forces
         the factorization; default uses every visible device. TB windows
-        only; integer keys in [0, key_capacity)."""
+        only (CB needs a serialized per-key arrival counter — see
+        PARITY.md); ARBITRARY int64 keys, densified to
+        ``key_capacity`` slots by a host KeySlotMap (more distinct keys
+        than the capacity raise)."""
         self._mesh_cfg = {"n_devices": n_devices, "mesh_shape": mesh_shape,
                           "local_batch": local_batch,
                           "fire_rounds": fire_rounds,

@@ -97,7 +97,8 @@ class TPUStageEmitter(BasicEmitter):
             self.schema = TupleSchema.infer(payload)
         key = (self.key_extractor(payload)
                if self.key_extractor is not None else None)
-        buf = (hash(key) % self.num_dests) if self.routing == "keyby" else 0
+        buf = (_dest_of_key(key, self.num_dests)
+               if self.routing == "keyby" else 0)
         rows = self._rows[buf]
         if not rows:
             self._wms[buf] = wm
@@ -217,9 +218,14 @@ class TPUStageEmitter(BasicEmitter):
                 # modulo routes identically to the per-tuple hash of the
                 # CPU/TPU keyby emitters
                 dests = kcol.astype(np.int64) % self.num_dests
+            elif kcol.dtype.kind in "SU":
+                dests = _bytes_key_dests(kcol, n, self.num_dests)
             else:
+                # object keys (tuples, mixed types): the per-row Python
+                # cliff — documented + bounded in PERF.md
                 dests = np.fromiter(
-                    (hash(k) % self.num_dests for k in kcol.tolist()),
+                    (_dest_of_key(k, self.num_dests)
+                     for k in kcol.tolist()),
                     dtype=np.int64, count=n)
             for d in range(self.num_dests):
                 idx = np.nonzero(dests == d)[0]
@@ -370,6 +376,61 @@ class _D2HPipeline:
 _HASH_MODULUS = (1 << 61) - 1  # CPython hash(n) == n iff 0 <= n < 2^61-1
 
 
+def _bytes_key_dests(kcol: np.ndarray, n: int, num_dests: int) -> np.ndarray:
+    """Hash-free (no per-row Python) keyby routing for fixed-width
+    bytes/str key columns (dtype kind 'S'/'U'): vectorized FNV-1a over
+    the column viewed as CODEPOINTS ('U': one uint32 lane per char) or
+    bytes ('S'), SKIPPING zero lanes so the result is invariant to the
+    dtype's zero padding — the same key must route to the same
+    destination even when two batches of one stream infer different
+    fixed widths. NOT CPython-hash-compatible, which is fine: keyby
+    routing needs a deterministic, balanced key->dest map per edge, not
+    a globally blessed hash (the reference's ``keyby_emitter.hpp:
+    210-228`` likewise only needs std::hash determinism). Cost is
+    O(n * key_width) vectorized numpy passes — measured well under the
+    ~100 ns/row of a Python-level ``hash()`` call for realistic widths.
+    (Tried and rejected: np.unique + one hash per distinct key — the
+    C string sort alone costs more than these passes.)"""
+    if n == 0:
+        return np.zeros(0, np.int64)
+    lane = np.uint32 if kcol.dtype.kind == "U" else np.uint8
+    # normalize to native byte order first: a '>U4' column (frombuffer/
+    # parquet) viewed as uint32 lanes would hash byte-swapped codepoints
+    # and split a key's tuples across replicas vs native batches
+    kcol = kcol[:n].astype(kcol.dtype.newbyteorder("="), copy=False)
+    b = np.ascontiguousarray(kcol).view(lane).reshape(n, -1)
+    h = np.full(n, 0xcbf29ce484222325, np.uint64)
+    prime = np.uint64(0x100000001b3)
+    for j in range(b.shape[1]):
+        bj = b[:, j].astype(np.uint64)
+        h = np.where(bj != 0, (h ^ bj) * prime, h)
+    return (h % np.uint64(num_dests)).astype(np.int64)
+
+
+def _scalar_fnv(lanes) -> int:
+    """Scalar twin of ``_bytes_key_dests`` (zero lanes skipped): the
+    per-row emit path MUST route str/bytes keys identically to the
+    columnar path — a source may mix push() and push_columns() on one
+    stream, and a key's tuples must all reach the same replica."""
+    h = 0xcbf29ce484222325
+    for v in lanes:
+        if v:
+            h = ((h ^ v) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _dest_of_key(key, num_dests: int) -> int:
+    """Per-row keyby destination, consistent with the vectorized columnar
+    routing: FNV over codepoints for str (matching numpy 'U' columns) or
+    bytes ('S' columns), CPython hash for everything else (ints route as
+    identity either way)."""
+    if isinstance(key, str):
+        return _scalar_fnv(map(ord, key)) % num_dests
+    if isinstance(key, bytes):
+        return _scalar_fnv(key) % num_dests
+    return hash(key) % num_dests
+
+
 def _int_keys_hashable_as_identity(kcol: np.ndarray, n: int) -> bool:
     """True when ``kcol % num_dests`` routes exactly like the per-tuple
     ``hash(key) % num_dests`` of the CPU/TPU keyby emitters (keys must be
@@ -463,9 +524,12 @@ class TPUKeyByEmitter(BasicEmitter, _D2HPipeline):
                                                    batch.size)):
             # hash(n) == n for ints in [0, 2^61-1): vectorized routing
             dests = host_keys[:batch.size].astype(np.int64) % self.num_dests
+        elif (isinstance(host_keys, np.ndarray)
+                and host_keys.dtype.kind in "SU"):
+            dests = _bytes_key_dests(host_keys, batch.size, self.num_dests)
         else:
             dests = np.fromiter(
-                (hash(k) % self.num_dests for k in host_keys),
+                (_dest_of_key(k, self.num_dests) for k in host_keys),
                 dtype=np.int64, count=batch.size)
         for d in range(self.num_dests):
             idx = np.nonzero(dests == d)[0]

@@ -165,10 +165,9 @@ class FfatMeshReplica(TPUReplicaBase):
         da = self._mesh.shape["data"]
         local_batch = op.local_batch or max(
             1, math.ceil(batch.capacity / (ka * da)))
-        # keep in lockstep with sharded_ffat_forest's default: the ring
-        # must hold the window PLUS fire_rounds slides of unfired backlog
-        self._F = op.ring_panes or (1 << max(3, math.ceil(math.log2(
-            self.win_units + max(op.fire_rounds * self.slide_units, 16)))))
+        from ..parallel.mesh import default_ring_panes
+        self._F = op.ring_panes or default_ring_panes(
+            self.win_units, self.slide_units, op.fire_rounds)
         self._val_fields = list(batch.fields.keys())
         self._val_dtypes = {f: batch.schema.fields[f]
                             for f in self._val_fields}
@@ -215,8 +214,9 @@ class FfatMeshReplica(TPUReplicaBase):
                 f"(sparse/negative int64 ok); got dtype {keys.dtype}")
         # arbitrary int domain -> dense slots (the capacity guard lives
         # in _on_new_key: it fires against the DECLARED capacity, not
-        # the mesh-padded K_pad — acceptance must not depend on shape)
-        keys = self._keymap.slots_of(keys, keys, n).astype(np.int64)
+        # the mesh-padded K_pad — acceptance must not depend on shape;
+        # slots stay in the keymap's narrow dtype, _run_steps casts once)
+        keys = self._keymap.slots_of(keys, keys, n)
         panes = (batch.ts_host[:n] // self.op.pane_len).astype(np.int64)
         if self._pane_base is None:
             base = int(panes.min()) if n else 0

@@ -308,10 +308,12 @@ class FfatTPUReplica(TPUReplicaBase):
 
         def step(fields, comp, h_order, h_same, h_end,
                  h_flat, trees, tvalid,
-                 fire_pack, fire_mask, ktable,
-                 evict_pack, evict_mask):
-            fire_slots, fire_starts, fire_lens, fire_wids = fire_pack
-            evict_slots, evict_leaves = evict_pack
+                 fire_pack, ktable, evict_pack):
+            (fire_slots, fire_starts, fire_lens, fire_wids,
+             fire_mask_i) = fire_pack
+            fire_mask = fire_mask_i != 0
+            evict_slots, evict_leaves, evict_mask_i = evict_pack
+            evict_mask = evict_mask_i != 0
             # 1. lift + sort + segmented scan. WHERE the sort happens is
             # backend-dependent: on accelerators it runs in-program (device
             # work overlaps the host control plane); on the CPU backend the
@@ -441,10 +443,12 @@ class FfatTPUReplica(TPUReplicaBase):
         _, window_query = self._query_fns()
         use_ktable = self._use_ktable()
 
-        def fire(trees, tvalid, fire_pack, fire_mask, ktable,
-                 evict_pack, evict_mask):
-            fire_slots, fire_starts, fire_lens, fire_wids = fire_pack
-            evict_slots, evict_leaves = evict_pack
+        def fire(trees, tvalid, fire_pack, ktable, evict_pack):
+            (fire_slots, fire_starts, fire_lens, fire_wids,
+             fire_mask_i) = fire_pack
+            fire_mask = fire_mask_i != 0
+            evict_slots, evict_leaves, evict_mask_i = evict_pack
+            evict_mask = evict_mask_i != 0
             ftrees = tmap(lambda t: t[fire_slots], trees)
             fvalid = tvalid[fire_slots]
             qv, qr = jax.vmap(window_query)(ftrees, fvalid, fire_starts,
@@ -721,10 +725,8 @@ class FfatTPUReplica(TPUReplicaBase):
         transfer enqueues on a tunneled device."""
         c_slots, c_start0, c_k, c_wid0, c_ml = chunks
         E = max(1, W * self.slide_units)
-        f_pack = np.zeros((4, W), dtype=np.int32)
-        f_mask = np.zeros(W, dtype=bool)
-        e_pack = np.zeros((2, E), dtype=np.int32)
-        e_mask = np.zeros(E, dtype=bool)
+        f_pack = np.zeros((5, W), dtype=np.int32)
+        e_pack = np.zeros((3, E), dtype=np.int32)
         ar = self._segmented_arange(c_k)
         starts = np.repeat(c_start0, c_k) + ar * self.slide_units
         f_pack[0, :n_out] = np.repeat(c_slots, c_k)
@@ -739,7 +741,9 @@ class FfatTPUReplica(TPUReplicaBase):
         # guarantees live spans stay below F)
         f_pack[2, :n_out] = np.minimum(self.win_units,
                                        np.repeat(c_ml, c_k) + 1 - starts)
-        f_mask[:n_out] = True
+        f_pack[4, :n_out] = 1  # mask row: rides the SAME transfer as the
+        # spec rows (one H2D enqueue per pack instead of pack+mask pairs
+        # — per-call enqueues are the fixed cost on a tunneled device)
         f_pack[3, :n_out] = np.repeat(c_wid0, c_k) + ar
         # evicted panes: one contiguous range per chunk
         ne = np.maximum(
@@ -750,8 +754,8 @@ class FfatTPUReplica(TPUReplicaBase):
             ep = np.repeat(c_start0, ne) + self._segmented_arange(ne)
             e_pack[0, :tot_e] = np.repeat(c_slots, ne)
             e_pack[1, :tot_e] = ep % self.F
-            e_mask[:tot_e] = True
-        return f_pack, f_mask, e_pack, e_mask
+            e_pack[2, :tot_e] = 1
+        return f_pack, e_pack
 
     def _use_ktable(self) -> bool:
         """Whether programs gather the output key column from a
@@ -795,10 +799,8 @@ class FfatTPUReplica(TPUReplicaBase):
             import jax
             E = max(1, W * self.slide_units)
             z = self._zero_fire_cache[W] = (
-                jax.device_put(np.zeros((4, W), dtype=np.int32)),
-                jax.device_put(np.zeros(W, dtype=bool)),
-                jax.device_put(np.zeros((2, E), dtype=np.int32)),
-                jax.device_put(np.zeros(E, dtype=bool)))
+                jax.device_put(np.zeros((5, W), dtype=np.int32)),
+                jax.device_put(np.zeros((3, E), dtype=np.int32)))
         return z
 
     def _fire_step(self):
@@ -823,11 +825,9 @@ class FfatTPUReplica(TPUReplicaBase):
         # all-masked no-op run; tvalid is DONATED, so reassign it
         self.tvalid, *_ = self._fire_step()(
             self.trees, self.tvalid,
-            np.zeros((4, W), dtype=np.int32),
-            np.zeros(W, dtype=bool),
+            np.zeros((5, W), dtype=np.int32),
             self._ktable_arg(),
-            np.zeros((2, E), dtype=np.int32),
-            np.zeros(E, dtype=bool))
+            np.zeros((3, E), dtype=np.int32))
 
     def _run_step(self, fields, wm, cap, comp_p,
                   order_p, same_p, end_p, flat_p, frontier) -> None:
@@ -849,10 +849,10 @@ class FfatTPUReplica(TPUReplicaBase):
             if not first and not n_out:
                 break
             if n_out:
-                f_pack, f_mask, e_pack, e_mask = self._pack_fire_arrays(
+                f_pack, e_pack = self._pack_fire_arrays(
                     chunks, n_out, budget)
             else:  # no windows fired: constant device-resident zeros
-                f_pack, f_mask, e_pack, e_mask = self._zero_fire(budget)
+                f_pack, e_pack = self._zero_fire(budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
                 from .ops_tpu import cached_compile
@@ -872,24 +872,24 @@ class FfatTPUReplica(TPUReplicaBase):
                         other = (self.W_step if budget == self.W_cap
                                  else self.W_cap)
                         _M, cdt = self._comp_dtype()
-                        zf, zm, ze, zem = self._zero_fire(other)
+                        zf, ze = self._zero_fire(other)
                         # all-sentinel no-op on the forest; trees/tvalid
                         # are DONATED, so reassign them from the outputs
                         (self.trees, self.tvalid, *_) = step(
                             fields, np.full(cap, _M, dtype=cdt),
                             order_p, same_p, end_p, flat_p,
                             self.trees, self.tvalid,
-                            zf, zm, ktable, ze, zem)
+                            zf, ktable, ze)
                 (self.trees, self.tvalid, qr, qv, wid_dev,
                  key_dev) = step(
                     fields, comp_p, order_p, same_p,
                     end_p, flat_p, self.trees, self.tvalid,
-                    f_pack, f_mask, ktable, e_pack, e_mask)
+                    f_pack, ktable, e_pack)
             else:
                 # drain iterations: fire-only program (no rebuild)
                 self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
                     self.trees, self.tvalid,
-                    f_pack, f_mask, ktable, e_pack, e_mask)
+                    f_pack, ktable, e_pack)
             self.stats.device_programs_run += 1
             if n_out:
                 self._emit_windows(wm, chunks, n_out, qr, qv,
@@ -951,11 +951,11 @@ class FfatTPUReplica(TPUReplicaBase):
             n_out = int(chunks[2].sum())
             if not n_out:
                 return
-            f_pack, f_mask, e_pack, e_mask = self._pack_fire_arrays(
+            f_pack, e_pack = self._pack_fire_arrays(
                 chunks, n_out, self.W_cap)
             self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
-                self.trees, self.tvalid, f_pack, f_mask,
-                self._ktable_arg(), e_pack, e_mask)
+                self.trees, self.tvalid, f_pack,
+                self._ktable_arg(), e_pack)
             self.stats.device_programs_run += 1
             self._emit_windows(self.cur_wm, chunks, n_out, qr, qv,
                                wid_dev, key_dev, self.W_cap)
