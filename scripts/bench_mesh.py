@@ -40,29 +40,11 @@ SLIDE_US = 25_000
 TS_STEP = 50  # aggregate stream-time µs per tuple across all keys
 
 
-class _Sink:
-    def __init__(self):
-        self.windows = 0
-        self.last = None
-
-    def emit_device_batch(self, b):
-        self.windows += b.size
-        self.last = b
-
-    def set_stats(self, s):
-        pass
-
-    def propagate_punctuation(self, wm):
-        pass
-
-    def flush(self):
-        pass
-
-
 def main() -> None:
     import jax
     import numpy as np
 
+    import bench  # counting sink + chunk aggregation: ONE protocol
     from windflow_tpu.basic import WinType
     from windflow_tpu.tpu.batch import BatchTPU
     from windflow_tpu.tpu.ffat_mesh import Ffat_Windows_Mesh
@@ -79,7 +61,7 @@ def main() -> None:
         name="bench_mesh")
     op.build_replicas()
     rep = op.replicas[0]
-    sink = _Sink()
+    sink = bench._CountingEmitter()
     rep.emitter = sink
 
     schema = TupleSchema({"key": np.int32, "value": np.float32})
@@ -113,16 +95,15 @@ def main() -> None:
         el = time.perf_counter() - t0
         chunks.append((N_BATCHES * BATCH / el, (sink.windows - w0) / el))
 
-    tl = sorted(c[0] for c in chunks)
+    st = bench._chunk_stats(chunks)
     result = {
         "metric": "mesh_ffat_tuples_per_sec"
                   + ("" if platform == "tpu" else f" ({platform})"),
-        "value": round(sum(tl) / len(tl), 1),
+        "value": round(st["mean"], 1),
         "unit": "tuples/sec",
-        "value_min": round(tl[0], 1),
-        "value_best": round(tl[-1], 1),
-        "windows_per_sec": round(
-            sum(c[1] for c in chunks) / len(chunks), 1),
+        "value_min": round(st["min"], 1),
+        "value_best": round(st["best"], 1),
+        "windows_per_sec": round(st["wps_mean"], 1),
         "mesh_shape": dict(rep._mesh.shape),
         "global_batch": rep._GB,
         "device_programs": rep.stats.device_programs_run,

@@ -85,10 +85,11 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
-    tps, wps, p99, progs = bench._run_config(bench.N_KEYS, 64, 48,
-                                             lat_batches=0)
-    print(f"FFAT 64keys isolated: {tps/1e6:.1f}M t/s, {wps:,.0f} win/s, "
-          f"{progs} programs")
+    chunks, _p99, progs = bench._run_config(bench.N_KEYS, 64, 48,
+                                            lat_batches=0)
+    st = bench._chunk_stats(chunks)
+    print(f"FFAT 64keys isolated: {st['mean']/1e6:.1f}M t/s, "
+          f"{st['wps_mean']:,.0f} win/s, {progs} programs")
 
 
 if __name__ == "__main__":

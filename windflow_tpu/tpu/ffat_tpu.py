@@ -719,10 +719,10 @@ class FfatTPUReplica(TPUReplicaBase):
         """Chunk arrays -> padded fire/evict arrays for the device
         programs (shaped for budget ``W``; jit re-traces per shape). Pure
         numpy (repeat + segmented arange): zero per-window or per-chunk
-        Python. Fire metadata is PACKED into one (4, W) int32 array
-        (rows: slot, start, len, wid) and evictions into one (2, E)
-        (rows: slot, leaf) — fewer program arguments means fewer per-call
-        transfer enqueues on a tunneled device."""
+        Python. Fire metadata is PACKED into one (5, W) int32 array
+        (rows: slot, start, len, wid, mask) and evictions into one
+        (3, E) (rows: slot, leaf, mask) — fewer program arguments means
+        fewer per-call transfer enqueues on a tunneled device."""
         c_slots, c_start0, c_k, c_wid0, c_ml = chunks
         E = max(1, W * self.slide_units)
         f_pack = np.zeros((5, W), dtype=np.int32)
