@@ -440,3 +440,40 @@ def test_ffat_tpu_scalar_constant_lift_field():
     got_cnt = {k: (v[1] if v else None) for k, v in coll.results.items()}
     assert got_sum == exp_sum
     assert got_cnt == exp_cnt
+
+
+def test_ffat_tpu_deferred_rebuild_dataless_fire():
+    """Deferred-rebuild soundness (round 4): batches whose watermark is
+    PARKED run the ingest-only program (no level rebuild); the later
+    watermark jump fires windows DATALESSLY through the fire-only
+    program, which must see a settled forest (_ensure_rebuilt) — stale
+    internal nodes would fire empty/wrong windows for data ingested
+    during the parked phase."""
+    coll = DictWinCollector()
+    graph = PipeGraph("ffat_deferred", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        # watermark PARKED at 0 for the whole stream -> every staged
+        # batch runs the ingest-only program (nothing ever fireable);
+        # EOS then fires EVERY window datalessly through the fire-only
+        # program, which would read stale internal nodes without the
+        # _ensure_rebuilt settle (verified discriminating: neutering
+        # _ensure_rebuilt makes this test fail)
+        for i in range(100):
+            shipper.push_with_timestamp(TupleT(i % 3, i + 1, i * TS_STEP),
+                                        i * TS_STEP)
+
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b_: {"value": a["value"] + b_["value"]})
+          .with_key_by("key").with_tb_windows(WIN_US, SLIDE_US)
+          .with_num_win_per_batch(8).build())
+    graph.add_source(Source_Builder(src).with_output_batch_size(16).build()
+                     ).add(op).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    seqs = {k: [(i + 1, i * TS_STEP) for i in range(100) if i % 3 == k]
+            for k in range(3)}
+    expected = expected_windows(seqs, WIN_US, SLIDE_US, False, sum_or_none)
+    assert coll.dups == 0
+    assert coll.results == expected

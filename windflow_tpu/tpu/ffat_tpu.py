@@ -149,6 +149,10 @@ class FfatTPUReplica(TPUReplicaBase):
         # numpy zeros/dummies every batch on a tunneled device)
         self._zero_fire_cache: Dict[int, Any] = {}
         self._seg_dummy = None
+        # deferred-rebuild flag: True while internal tree levels are
+        # stale w.r.t. leaves (ingest-only batches ran since the last
+        # rebuild); every fire path rebuilds first (see _make_step)
+        self._rebuild_dirty = False
         # device-resident per-slot key table (lazy; see _ktable_arg)
         self._ktable_dev = None
         self._ktable_kd = None
@@ -279,7 +283,58 @@ class FfatTPUReplica(TPUReplicaBase):
 
         return comb_valid, window_query
 
-    def _make_step(self, cap: int, donate: bool = True):
+    def _rebuild_fn(self):
+        """(pallas_or_none, xla_rebuild): the full-forest internal-level
+        rebuild — the ONE definition shared by the in-program rebuild
+        and the standalone settle program (divergence here would make
+        deferred batches aggregate differently from direct ones), plus
+        the optional Pallas fast path both route through when enabled."""
+        import jax
+        import jax.numpy as jnp
+
+        combine = self.op.combine
+        F = self.F
+        tmap = jax.tree_util.tree_map
+        pallas_rebuild = None
+        from .pallas_kernels import make_forest_rebuild, pallas_enabled
+        if pallas_enabled() and self.trees is not None and self.K_cap >= 8:
+            pallas_rebuild = make_forest_rebuild(
+                combine, list(self.trees.keys()), F,
+                interpret=jax.default_backend() != "tpu")
+
+        def rebuild_levels(trees, tvalid):
+            if pallas_rebuild is not None:
+                return pallas_rebuild(trees, tvalid)
+            lvl = F >> 1
+            while lvl >= 1:
+                lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
+                rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
+                vlc = tvalid[:, 2 * lvl:4 * lvl:2]
+                vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
+                merged = combine(lc, rc)
+                node = tmap(lambda m, a, b: jnp.where(
+                    vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
+                trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
+                             trees, node)
+                tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
+                lvl >>= 1
+            return trees, tvalid
+
+        return rebuild_levels
+
+    def _make_step(self, cap: int, donate: bool = True,
+                   ingest_only: bool = False):
+        """``ingest_only=True`` builds the DEFERRED-REBUILD variant: lift
+        + segmented scan + leaf scatter only — no level rebuild, no
+        window queries, no eviction. Used for batches the host control
+        plane already knows fire NOTHING (chunks empty): leaves stay
+        current and the next firing program's full-forest rebuild covers
+        every deferred batch at once, so the per-batch rebuild cost —
+        independent of batch size, hence the dominant term of the
+        low-cardinality small-batch regime — is paid per FIRING batch
+        only. Soundness: internal nodes are only ever read by fire
+        queries, and every fire path rebuilds first (the full program
+        in-program; the dataless path via _ensure_rebuilt)."""
         import jax
         import jax.numpy as jnp
 
@@ -295,16 +350,9 @@ class FfatTPUReplica(TPUReplicaBase):
 
         tmap = jax.tree_util.tree_map
         comb_valid, window_query = self._query_fns()
-
-        # optional pallas level-rebuild (WF_PALLAS=1): one VMEM round-trip
-        # per key block instead of one HBM materialization per level; the
-        # interpreter validates it off-TPU
-        pallas_rebuild = None
-        from .pallas_kernels import make_forest_rebuild, pallas_enabled
-        if pallas_enabled() and self.trees is not None and K_cap >= 8:
-            pallas_rebuild = make_forest_rebuild(
-                combine, list(self.trees.keys()), F,
-                interpret=jax.default_backend() != "tpu")
+        # shared rebuild body (routes through the WF_PALLAS=1 VMEM
+        # kernel when enabled; see _rebuild_fn)
+        rebuild_levels = self._rebuild_fn()
 
         def step(fields, comp, h_order, h_same, h_end,
                  h_flat, trees, tvalid,
@@ -368,23 +416,18 @@ class FfatTPUReplica(TPUReplicaBase):
             tvalid = tvalid.reshape(-1).at[safe_idx].set(
                 True, mode="drop").reshape(tvalid.shape)
 
+            if ingest_only:
+                # deferred rebuild: leaves are current, internal nodes
+                # stale until the next firing/rebuild program; dummies
+                # keep the output arity (callers never read them — the
+                # host knew n_out == 0 before choosing this program)
+                dummy = tmap(lambda a: jnp.zeros((1,), a.dtype), vals)
+                return (trees, tvalid, dummy, jnp.zeros((1,), bool),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((1,), jnp.int32))
+
             # 3. rebuild internal levels across the whole forest
-            if pallas_rebuild is not None:
-                trees, tvalid = pallas_rebuild(trees, tvalid)
-            else:
-                lvl = F >> 1
-                while lvl >= 1:
-                    lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
-                    rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
-                    vlc = tvalid[:, 2 * lvl:4 * lvl:2]
-                    vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
-                    merged = combine(lc, rc)
-                    node = tmap(lambda m, a, b: jnp.where(
-                        vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
-                    trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
-                                 trees, node)
-                    tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
-                    lvl >>= 1
+            trees, tvalid = rebuild_levels(trees, tvalid)
 
             # 4. fired-window queries (vmapped over W_cap)
             ftrees = tmap(lambda t: t[fire_slots], trees)
@@ -469,6 +512,29 @@ class FfatTPUReplica(TPUReplicaBase):
         # tvalid donated (in-place eviction); trees is read-only here
         return jax.jit(fire, donate_argnums=(1,))
 
+    def _make_rebuild_step(self):
+        """Standalone full-forest level rebuild: settles deferred
+        (ingest-only) batches before a DATALESS fire — the fire-only
+        program skips the rebuild by design and is only sound over a
+        freshly rebuilt forest (see _make_fire_step). Shares the rebuild
+        body (and the Pallas fast path) with the full program."""
+        import jax
+
+        return jax.jit(self._rebuild_fn(), donate_argnums=(0, 1))
+
+    def _ensure_rebuilt(self) -> None:
+        """Run the standalone rebuild iff ingest-only batches deferred
+        it (idempotent: rebuilding from current leaves is always safe)."""
+        if not self._rebuild_dirty or self.trees is None:
+            return
+        from .ops_tpu import cached_compile
+        prog = cached_compile(self._prog_cache, self.op._prog_lock,
+                              ("rebuild", self.K_cap, self.F),
+                              self._make_rebuild_step)
+        self.trees, self.tvalid = prog(self.trees, self.tvalid)
+        self.stats.device_programs_run += 1
+        self._rebuild_dirty = False
+
     # ==================================================================
     # host control plane
     # ==================================================================
@@ -534,6 +600,9 @@ class FfatTPUReplica(TPUReplicaBase):
                 lambda new, old: new.at[sr, dc].set(old[sr, sc]),
                 self.trees, old_trees)
             self.tvalid = self.tvalid.at[sr, dc].set(old_valid[sr, sc])
+        # only leaves were carried over: internal levels need a rebuild
+        # before any fire-only program may query them
+        self._rebuild_dirty = True
         self._check_index_plane()
 
     def _ensure_forest(self, sample_fields) -> None:
@@ -829,6 +898,53 @@ class FfatTPUReplica(TPUReplicaBase):
             self._ktable_arg(),
             np.zeros((3, E), dtype=np.int32))
 
+    def _warm_programs(self, cap, ckey, ikey, fields,
+                       order_p, same_p, end_p, flat_p, ktable) -> None:
+        """Compile every program variant of a capacity bucket with no-op
+        sentinel runs (masked rows, zero fire args): the full step (both
+        fire-budget tiers on accelerators), the ingest-only deferred-
+        rebuild step, the fire-only drain step, and the standalone
+        rebuild. All runs are semantic no-ops on the forest (sentinel
+        rows drop, rebuild is idempotent); trees/tvalid are DONATED, so
+        each run reassigns them."""
+        from .ops_tpu import cached_compile
+        step = cached_compile(self._prog_cache, self.op._prog_lock,
+                              ckey, lambda: self._make_step(cap))
+        istep = cached_compile(
+            self._prog_cache, self.op._prog_lock, ikey,
+            lambda: self._make_step(cap, ingest_only=True))
+        self._warm_fire_step()
+        rkey = ("rebuild", self.K_cap, self.F)
+        rb = None if rkey in self._prog_cache else cached_compile(
+            self._prog_cache, self.op._prog_lock, rkey,
+            self._make_rebuild_step)  # cap-independent: a later capacity
+        # bucket must not pay a redundant full-forest rebuild execution
+        if self._host_seg:
+            # host-segmentation no-op: no segment ends -> scatter drops.
+            # dtypes must MATCH the real call site (int32 order/flat,
+            # bool same/end) or the warm compiles a shape nobody reuses
+            comp_s = np.zeros(1, self._comp_dtype()[1])
+            seg = (np.arange(cap, dtype=np.int32), np.zeros(cap, bool),
+                   np.zeros(cap, bool),
+                   np.zeros(cap, dtype=np.int32))
+        else:
+            _M, cdt = self._comp_dtype()
+            comp_s = np.full(cap, _M, dtype=cdt)  # all-sentinel lanes
+            seg = (order_p, same_p, end_p, flat_p)
+        tiers = {self.W_step}
+        if self._on_accelerator():
+            tiers.add(self.W_cap)
+        for W in tiers:
+            zf, ze = self._zero_fire(W)
+            (self.trees, self.tvalid, *_) = step(
+                fields, comp_s, *seg, self.trees, self.tvalid,
+                zf, ktable, ze)
+        zf, ze = self._zero_fire(self.W_step)
+        (self.trees, self.tvalid, *_) = istep(
+            fields, comp_s, *seg, self.trees, self.tvalid, zf, ktable, ze)
+        if rb is not None:
+            self.trees, self.tvalid = rb(self.trees, self.tvalid)
+
     def _run_step(self, fields, wm, cap, comp_p,
                   order_p, same_p, end_p, flat_p, frontier) -> None:
         if order_p is None:  # device mode: cached 1-elem dummies
@@ -839,6 +955,17 @@ class FfatTPUReplica(TPUReplicaBase):
                     np.zeros(1, dtype=bool), np.zeros(1, dtype=np.int32)))
             order_p, same_p, end_p, flat_p = self._seg_dummy
         ktable = self._ktable_arg()
+        from .ops_tpu import cached_compile
+        ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
+                self._use_ktable(), str(self._key_dtype))
+        ikey = ("ingest", cap, self.K_cap, self.F, self._host_seg)
+        if ckey not in self._prog_cache or ikey not in self._prog_cache:
+            # first batch of this capacity bucket: compile EVERY program
+            # variant now (full both tiers, ingest-only, fire-only,
+            # standalone rebuild) so no later batch — firing or not —
+            # pays a mid-stream compile
+            self._warm_programs(cap, ckey, ikey, fields, order_p, same_p,
+                                end_p, flat_p, ktable)
         first = True
         total_fired = 0
         first_budget = self._first_budget()
@@ -848,52 +975,38 @@ class FfatTPUReplica(TPUReplicaBase):
             n_out = int(chunks[2].sum())
             if not first and not n_out:
                 break
-            if n_out:
-                f_pack, e_pack = self._pack_fire_arrays(
-                    chunks, n_out, budget)
-            else:  # no windows fired: constant device-resident zeros
-                f_pack, e_pack = self._zero_fire(budget)
+            if first and not n_out:
+                # nothing fireable: ingest-only program, rebuild DEFERRED
+                # to the next firing/rebuild program (the rebuild cost is
+                # batch-size-independent — the dominant per-batch term of
+                # the low-cardinality small-batch regime). Fire args are
+                # unused in this variant but still traced: pin the
+                # W_step shape so tier switches never retrace it
+                zf, ze = self._zero_fire(self.W_step)
+                (self.trees, self.tvalid, *_) = self._prog_cache[ikey](
+                    fields, comp_p, order_p, same_p, end_p, flat_p,
+                    self.trees, self.tvalid, zf, ktable, ze)
+                self._rebuild_dirty = True
+                self.stats.device_programs_run += 1
+                break
+            f_pack, e_pack = self._pack_fire_arrays(chunks, n_out, budget)
             if first:
                 # full program: lift + scan + scatter + rebuild + fire
-                from .ops_tpu import cached_compile
-                ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
-                        self._use_ktable(), str(self._key_dtype))
-                fresh = ckey not in self._prog_cache
-                step = cached_compile(self._prog_cache, self.op._prog_lock,
-                                      ckey, lambda: self._make_step(cap))
-                if fresh:
-                    self._warm_fire_step()
-                    if self._on_accelerator() and self.W_cap != self.W_step:
-                        # eagerly compile the OTHER tier's shape of the
-                        # full program (all-sentinel no-op run, outputs
-                        # discarded; the real call below traces this
-                        # batch's tier): tier switches must never pay a
-                        # mid-stream compile
-                        other = (self.W_step if budget == self.W_cap
-                                 else self.W_cap)
-                        _M, cdt = self._comp_dtype()
-                        zf, ze = self._zero_fire(other)
-                        # all-sentinel no-op on the forest; trees/tvalid
-                        # are DONATED, so reassign them from the outputs
-                        (self.trees, self.tvalid, *_) = step(
-                            fields, np.full(cap, _M, dtype=cdt),
-                            order_p, same_p, end_p, flat_p,
-                            self.trees, self.tvalid,
-                            zf, ktable, ze)
                 (self.trees, self.tvalid, qr, qv, wid_dev,
-                 key_dev) = step(
+                 key_dev) = self._prog_cache[ckey](
                     fields, comp_p, order_p, same_p,
                     end_p, flat_p, self.trees, self.tvalid,
                     f_pack, ktable, e_pack)
+                self._rebuild_dirty = False  # in-program rebuild covers
+                # every deferred ingest-only batch (full-forest rebuild)
             else:
                 # drain iterations: fire-only program (no rebuild)
                 self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
                     self.trees, self.tvalid,
                     f_pack, ktable, e_pack)
             self.stats.device_programs_run += 1
-            if n_out:
-                self._emit_windows(wm, chunks, n_out, qr, qv,
-                                   wid_dev, key_dev, budget)
+            self._emit_windows(wm, chunks, n_out, qr, qv,
+                               wid_dev, key_dev, budget)
             total_fired += n_out
             first = False
             if n_out < budget:
@@ -943,7 +1056,9 @@ class FfatTPUReplica(TPUReplicaBase):
     # ------------------------------------------------------------------
     def _fire_dataless(self, frontier, partial: bool) -> None:
         """Watermark/EOS made windows fireable without new data: run ONLY
-        the fire-only program (no lift/scan/rebuild at all)."""
+        the fire-only program (no lift/scan/rebuild at all) — after
+        settling any rebuild deferred by ingest-only batches, since the
+        fire-only program is sound only over a rebuilt forest."""
         if self.trees is None:
             return
         while True:
@@ -951,6 +1066,7 @@ class FfatTPUReplica(TPUReplicaBase):
             n_out = int(chunks[2].sum())
             if not n_out:
                 return
+            self._ensure_rebuilt()
             f_pack, e_pack = self._pack_fire_arrays(
                 chunks, n_out, self.W_cap)
             self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
