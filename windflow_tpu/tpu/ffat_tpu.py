@@ -284,11 +284,11 @@ class FfatTPUReplica(TPUReplicaBase):
         return comb_valid, window_query
 
     def _rebuild_fn(self):
-        """(pallas_or_none, xla_rebuild): the full-forest internal-level
-        rebuild — the ONE definition shared by the in-program rebuild
-        and the standalone settle program (divergence here would make
-        deferred batches aggregate differently from direct ones), plus
-        the optional Pallas fast path both route through when enabled."""
+        """Returns the full-forest internal-level rebuild callable — the
+        ONE definition shared by the in-program rebuild and the
+        standalone settle program (divergence here would make deferred
+        batches aggregate differently from direct ones); routes through
+        the optional Pallas fast path when enabled."""
         import jax
         import jax.numpy as jnp
 
@@ -955,7 +955,6 @@ class FfatTPUReplica(TPUReplicaBase):
                     np.zeros(1, dtype=bool), np.zeros(1, dtype=np.int32)))
             order_p, same_p, end_p, flat_p = self._seg_dummy
         ktable = self._ktable_arg()
-        from .ops_tpu import cached_compile
         ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
                 self._use_ktable(), str(self._key_dtype))
         ikey = ("ingest", cap, self.K_cap, self.F, self._host_seg)
