@@ -370,19 +370,34 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
         max_leaf = lax.pmax(max_leaf, "data")
 
         # ---- level rebuild across the shard's forest -------------------
-        lvl = F >> 1
-        while lvl >= 1:
-            lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
-            rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
-            vlc = tvalid[:, 2 * lvl:4 * lvl:2]
-            vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
-            merged = combine(lc, rc)
-            node = tmap(lambda m, a, b: jnp.where(
-                vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
-            trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
-                         trees, node)
-            tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
-            lvl >>= 1
+        # SKIPPED (lax.cond) when no owned key can fire this step: the
+        # mesh rebuilds from leaves in-step, so internal nodes are only
+        # ever read by this step's own fire rounds — a non-firing step
+        # leaves them stale with no reader, and the next firing step's
+        # cond takes the rebuild branch. The rebuild is O(keys × ring)
+        # regardless of batch size: the dominant per-step term under
+        # periodic (sparse) watermarks.
+        def _rebuild(carry):
+            trees, tvalid = carry
+            lvl = F >> 1
+            while lvl >= 1:
+                lc = tmap(lambda t: t[:, 2 * lvl:4 * lvl:2], trees)
+                rc = tmap(lambda t: t[:, 2 * lvl + 1:4 * lvl:2], trees)
+                vlc = tvalid[:, 2 * lvl:4 * lvl:2]
+                vrc = tvalid[:, 2 * lvl + 1:4 * lvl:2]
+                merged = combine(lc, rc)
+                node = tmap(lambda m, a, b: jnp.where(
+                    vlc & vrc, m, jnp.where(vlc, a, b)), merged, lc, rc)
+                trees = tmap(lambda t, nd: t.at[:, lvl:2 * lvl].set(nd),
+                             trees, node)
+                tvalid = tvalid.at[:, lvl:2 * lvl].set(vlc | vrc)
+                lvl >>= 1
+            return trees, tvalid
+
+        any_elig = jnp.any((next_fire + win_panes <= frontier)
+                           & (max_leaf >= next_fire))
+        trees, tvalid = lax.cond(any_elig, _rebuild, lambda c: c,
+                                 (trees, tvalid))
 
         # ---- device-side fire rounds -----------------------------------
         pv = lambda a: lax.pcast(a, ("key", "data"), to="varying")
