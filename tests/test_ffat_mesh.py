@@ -303,17 +303,56 @@ def test_mesh_idle_key_resume_no_ring_aliasing():
         f"extra={sorted(set(got) - set(exp))[:6]}")
 
 
-def test_mesh_outrunning_watermark_raises():
-    """Data further ahead of the watermark than the ring can absorb must
-    raise loudly (the knob is with_mesh(ring_panes=...)), never alias."""
+@needs_multi
+def test_mesh_outrun_grows_ring():
+    """A source briefly outrunning its watermarks (pane far past the
+    ring's headroom) triggers host-driven ring GROWTH with leaf
+    migration — the single-chip plane's _grow_ring analog (round-4
+    parity; previously fatal) — and the results stay exact."""
+    coll = Collector()
+    graph = PipeGraph("mesh_grow", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for p in range(8):  # panes 0..7 live (no watermark yet)
+            shipper.push_with_timestamp({"key": 0, "value": 1.0}, p)
+        # pane 400 >> F(32)-win with frontier still 0: must GROW (to 512
+        # panes), migrating the live leaves — then fire correctly
+        for p in range(400, 404):
+            shipper.push_with_timestamp({"key": 0, "value": 1.0}, p)
+        shipper.set_next_watermark(410)
+
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b: {"value": a["value"] + b["value"]})
+          .with_key_by("key").with_tb_windows(4, 1)
+          .with_key_capacity(1).with_mesh().build())
+    graph.add_source(Source_Builder(src).with_output_batch_size(4).build()
+                     ).add(op).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    got = {k: v for k, v in coll.rows.items() if v is not None}
+    tuples = set(range(8)) | set(range(400, 404))
+    exp = {}
+    for w in range(0, 404):
+        s = sum(1.0 for p in range(w, w + 4) if p in tuples)
+        if s:
+            exp[(0, w)] = s
+    assert got == exp, (
+        f"missing={sorted(set(exp) - set(got))[:6]} "
+        f"extra={sorted(set(got) - set(exp))[:6]}")
+
+
+def test_mesh_outrunning_watermark_beyond_cap_raises():
+    """Growth is refused past RING_CAP_PANES (an outrun of a million
+    panes is a watermark bug, not a burst): the loud error remains."""
     graph = PipeGraph("mesh_outrun", ExecutionMode.DEFAULT,
                       TimePolicy.EVENT_TIME)
 
     def src(shipper, ctx):
         for p in range(8):
             shipper.push_with_timestamp({"key": 0, "value": 1.0}, p)
-        # no watermark: frontier stays 0; pane 400 >> F-win
-        shipper.push_with_timestamp({"key": 0, "value": 1.0}, 400)
+        # no watermark: frontier stays 0; pane 2^21 >> RING_CAP_PANES
+        shipper.push_with_timestamp({"key": 0, "value": 1.0}, 1 << 21)
 
     op = (Ffat_Windows_TPU_Builder(
             lambda f: {"value": f["value"]},

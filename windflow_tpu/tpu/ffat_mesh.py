@@ -39,9 +39,13 @@ Design (vs the single-chip ``tpu/ffat_tpu.py``):
   pane domain cannot represent. Keys that go idle are fast-forwarded past
   the frontier inside the step (their skipped windows are provably
   empty), so an idle-resume key can never read aliased ring leaves; and
-  tuples more than ``ring - win`` panes AHEAD of
-  the frontier raise loudly — size the ring via ``with_mesh(ring_panes=)``
-  for sources that outrun their watermarks.
+  tuples more than ``ring - win`` panes AHEAD of the frontier trigger
+  host-driven ring GROWTH with leaf migration (the single-chip plane's
+  ``_grow_ring`` analog: geometric doubling, one step recompile per
+  growth, internal levels rebuilt by the next firing step) — growth past
+  ``RING_CAP_PANES`` (2^20 panes per key) is refused with a loud error,
+  since an outrun that large is a watermark bug; ``with_mesh(ring_panes=)``
+  pre-sizes the ring for known-bursty sources.
 
 One step per staged input batch (padded to the mesh's global batch with
 key = -1 lanes, which the routing drops); partial tail batches therefore
@@ -156,7 +160,7 @@ class FfatMeshReplica(TPUReplicaBase):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.mesh import make_key_mesh, sharded_ffat_forest
+        from ..parallel.mesh import make_key_mesh
 
         op = self.op
         n_dev = op.n_devices or len(jax.devices())
@@ -171,14 +175,8 @@ class FfatMeshReplica(TPUReplicaBase):
         self._val_fields = list(batch.fields.keys())
         self._val_dtypes = {f: batch.schema.fields[f]
                             for f in self._val_fields}
-        try:
-            init_fn, step, (K_pad, k_local, GB) = sharded_ffat_forest(
-                self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
-                win_panes=self.win_units, slide_panes=self.slide_units,
-                local_batch=local_batch, fire_rounds=op.fire_rounds,
-                ring_panes=self._F)
-        except ValueError as e:  # config validation -> framework error
-            raise WindFlowError(f"{op.name}: {e}") from None
+        self._local_batch = local_batch
+        init_fn, step, (K_pad, k_local, GB) = self._build_forest(self._F)
         self._step = step
         self._GB, self._K_pad = GB, K_pad
         sample = {f: np.zeros(1, dt) for f, dt in self._val_dtypes.items()}
@@ -186,6 +184,21 @@ class FfatMeshReplica(TPUReplicaBase):
             lambda v: op.lift(v), sample).keys())
         self._state = init_fn(sample)
         self._sharding = NamedSharding(self._mesh, P(("key", "data")))
+
+    def _build_forest(self, ring_panes: int):
+        """ONE construction path for the sharded step (initial build and
+        ring growth must never drift apart in config or error handling)."""
+        from ..parallel.mesh import sharded_ffat_forest
+
+        op = self.op
+        try:
+            return sharded_ffat_forest(
+                self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
+                win_panes=self.win_units, slide_panes=self.slide_units,
+                local_batch=self._local_batch,
+                fire_rounds=op.fire_rounds, ring_panes=ring_panes)
+        except ValueError as e:  # config validation -> framework error
+            raise WindFlowError(f"{op.name}: {e}") from None
 
     # -- streaming ------------------------------------------------------
     def _rebased_frontier(self) -> int:
@@ -275,11 +288,73 @@ class FfatMeshReplica(TPUReplicaBase):
             if self._backlog_bound > 0:
                 self._catch_up()
                 continue
+            if self._grow_ring_to(max_pane):
+                continue  # re-check against the grown ring
             raise WindFlowError(
                 f"{self.op.name}: pane {max_pane} is more than ring-win "
                 f"({self._F}-{self.win_units}) panes ahead of the "
-                f"watermark frontier {self._frontier}; advance watermarks "
-                "faster or raise with_mesh(ring_panes=...)")
+                f"watermark frontier {self._frontier}, and growing the "
+                f"ring past {self.RING_CAP_PANES} panes is refused "
+                "(a source outrunning its watermarks by that much is a "
+                "watermark bug); advance watermarks faster or raise "
+                "with_mesh(ring_panes=...)")
+
+    RING_CAP_PANES = 1 << 20  # growth refusal threshold (per-key panes)
+
+    def _grow_ring_to(self, max_pane: int) -> bool:
+        """Ring growth with state migration — the mesh analog of the
+        single-chip plane's ``_grow_ring`` (a source briefly outrunning
+        its watermarks must not be fatal). Host-driven: fetch the forest,
+        re-map LIVE LEAVES ``pane % F -> pane % F'`` per key, rebuild the
+        sharded step for the larger ring, and re-shard the migrated
+        state. Internal levels are left invalid — the first firing
+        step's in-program rebuild recomputes them from leaves (the same
+        contract the conditional rebuild relies on). Returns False when
+        the needed ring exceeds RING_CAP_PANES (caller raises)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        op = self.op
+        new_F = self._F
+        while (max_pane - self._frontier + self.win_units >= new_F
+               or new_F < self.win_units
+               + op.fire_rounds * self.slide_units):
+            new_F *= 2
+            if new_F > self.RING_CAP_PANES:
+                return False
+        trees = {f: np.asarray(v) for f, v in self._state[0].items()}
+        tvalid = np.asarray(self._state[1])
+        nf = np.asarray(self._state[2]).astype(np.int64)
+        ml = np.asarray(self._state[3]).astype(np.int64)
+        fired = np.asarray(self._state[4])
+        K_pad = tvalid.shape[0]
+        old_F = self._F
+        spans = np.maximum(0, ml - nf + 1)
+        rows = np.repeat(np.arange(K_pad), spans)
+        before = np.cumsum(spans) - spans
+        seg = np.arange(int(spans.sum()), dtype=np.int64) \
+            - np.repeat(before, spans)
+        panes = np.repeat(nf, spans) + seg
+        src = old_F + (panes % old_F)
+        dst = new_F + (panes % new_F)
+        new_trees = {f: np.zeros((K_pad, 2 * new_F), t.dtype)
+                     for f, t in trees.items()}
+        new_tvalid = np.zeros((K_pad, 2 * new_F), bool)
+        for f, t in trees.items():
+            new_trees[f][rows, dst] = t[rows, src]
+        new_tvalid[rows, dst] = tvalid[rows, src]
+        _init, step, (_kp, _kl, _gb) = self._build_forest(new_F)
+        sh_keys = NamedSharding(self._mesh, P("key", None))
+        sh_key1 = NamedSharding(self._mesh, P("key"))
+        self._step = step
+        self._state = (
+            {f: jax.device_put(a, sh_keys) for f, a in new_trees.items()},
+            jax.device_put(new_tvalid, sh_keys),
+            jax.device_put(nf.astype(np.int32), sh_key1),
+            jax.device_put(ml.astype(np.int32), sh_key1),
+            jax.device_put(fired, sh_key1))
+        self._F = new_F
+        return True
 
     def _catch_up(self) -> None:
         """Fire the backlog with data-less steps. ONE control-state fetch
