@@ -1,0 +1,105 @@
+"""Randomized differential soak: random FFAT_TPU configs (TB/CB, win,
+slide, keys, parallelism, batch sizes, watermark cadence, lateness)
+through full PipeGraphs vs the canonical window model. Prints any
+mismatching config; exits 0 after the time budget with a summary."""
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "1200"))
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+from common import TupleT, expected_windows
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "0"))
+
+while time.monotonic() < t_end:
+    runs += 1
+    n_keys = rng.choice([1, 2, 3, 5, 9, 17])
+    stream_len = rng.choice([40, 90, 150])
+    ts_step = rng.choice([37, 100, 137, 250])
+    cb = rng.random() < 0.4
+    if cb:
+        win, slide = rng.randint(2, 20), rng.randint(1, 12)
+    else:
+        win = rng.choice([300, 500, 800, 1000, 1700])
+        slide = rng.choice([200, 400, 700, 800, 1100])
+    obs = rng.choice([8, 16, 32, 64])
+    src_par = rng.choice([1, 1, 2])
+    nwpb = rng.choice([4, 8, 16])
+    lateness = rng.choice([0, 0, 0, 200])
+    wm_every = rng.choice([1, 1, 4, 16])
+    seed = rng.randrange(1 << 30)
+
+    def make_src(nk, sl):
+        def src(shipper, ctx):
+            r2 = random.Random(seed + ctx.get_replica_index())
+            for i in range(sl):
+                ts = i * ts_step
+                for k in range(ctx.get_replica_index(), nk,
+                               ctx.get_parallelism()):
+                    shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts), ts)
+                if i % wm_every == wm_every - 1:
+                    shipper.set_next_watermark(ts)
+        return src
+
+    import threading
+    lock = threading.Lock()
+    results, dups = {}, [0]
+
+    def sink(r):
+        if r is None:
+            return
+        with lock:
+            kk = (r["key"], r["wid"])
+            if kk in results:
+                dups[0] += 1
+            results[kk] = r["value"] if r["valid"] else None
+
+    cfg = dict(n_keys=n_keys, stream=stream_len, ts_step=ts_step,
+               cb=cb, win=win, slide=slide, obs=obs, src_par=src_par,
+               nwpb=nwpb, lateness=lateness, wm_every=wm_every)
+    try:
+        g = PipeGraph(f"soak{runs}", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+        b = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b_: {"value": a["value"] + b_["value"]})
+             .with_key_by("key").with_lateness(lateness)
+             .with_num_win_per_batch(nwpb))
+        b = b.with_cb_windows(win, slide) if cb \
+            else b.with_tb_windows(win, slide)
+        g.add_source(Source_Builder(make_src(n_keys, stream_len))
+                     .with_parallelism(src_par)
+                     .with_output_batch_size(obs).build()
+                     ).add(b.build()).add_sink(Sink_Builder(sink).build())
+        g.run()
+        seqs = {k: [(i + 1 + k, i * ts_step) for i in range(stream_len)]
+                for k in range(n_keys)}
+        exp = expected_windows(seqs, win, slide, cb,
+                               lambda v: sum(v) if v else None)
+        # lateness/wm cadence never drop in-order streams (ts monotone),
+        # so results must match exactly
+        if results != exp or dups[0]:
+            fails += 1
+            miss = {k: (exp.get(k), results.get(k))
+                    for k in set(exp) | set(results)
+                    if exp.get(k) != results.get(k)}
+            print(f"MISMATCH run={runs} cfg={cfg} dups={dups[0]} "
+                  f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"soak done: {runs} runs, {fails} failures", flush=True)
+sys.exit(1 if fails else 0)

@@ -1,0 +1,129 @@
+"""Randomized differential soak for Ffat_Windows_Mesh: random mesh
+shapes, sparse/negative keys, win/slide, watermark cadence, IDLE GAPS
+(the round-4 fast-forward surface), batch sizes — vs an origin-anchored
+oracle. Prints mismatching configs; summary at the end."""
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "1200"))
+
+import numpy as np
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "1"))
+
+while time.monotonic() < t_end:
+    runs += 1
+    n_keys = rng.choice([1, 2, 3, 7, 11])
+    sparse = rng.random() < 0.5
+    keymap = ([k for k in range(n_keys)] if not sparse else
+              [(k * 2_654_435_761 - 3_000_000_000) * (3 + k)
+               for k in range(n_keys)])
+    win_us = rng.choice([400, 800, 900, 1500])
+    slide_us = rng.choice([100, 200, 300, 450])
+    obs = rng.choice([8, 16, 32])
+    wm_every = rng.choice([8, 16])
+    mesh_shape = rng.choice([None, (8, 1), (4, 2), (2, 4)])
+    fire_rounds = rng.choice([2, 4])
+    # stream: phase 1, optional idle gap (watermark-only advance),
+    # phase 2 resume — all timestamps monotone
+    p1 = rng.choice([40, 80])
+    gap = rng.choice([0, 0, 60, 200])  # in ts-steps
+    p2 = rng.choice([0, 30, 60])
+    ts_step = rng.choice([37, 97])
+    seed = rng.randrange(1 << 30)
+
+    def src(shipper, ctx):
+        i = 0
+        for j in range(p1):
+            ts = i * ts_step
+            for k in keymap:
+                shipper.push_with_timestamp(
+                    {"key": k, "value": float(i + 1)}, ts)
+            if j % wm_every == wm_every - 1:
+                shipper.set_next_watermark(ts)
+            i += 1
+        if gap:
+            i += gap
+            shipper.set_next_watermark((i - 1) * ts_step)
+        for j in range(p2):
+            ts = i * ts_step
+            for k in keymap:
+                shipper.push_with_timestamp(
+                    {"key": k, "value": float(i + 1)}, ts)
+            if j % wm_every == wm_every - 1:
+                shipper.set_next_watermark(ts)
+            i += 1
+
+    lock = threading.Lock()
+    rows, dups = {}, [0]
+
+    def sink(r):
+        if r is None or not r["valid"]:
+            return
+        with lock:
+            kk = (r["key"], r["wid"])
+            if kk in rows:
+                dups[0] += 1
+            rows[kk] = r["value"]
+
+    cfg = dict(n_keys=n_keys, sparse=sparse, win=win_us, slide=slide_us,
+               obs=obs, wm_every=wm_every, shape=mesh_shape,
+               fr=fire_rounds, p1=p1, gap=gap, p2=p2, ts_step=ts_step)
+    try:
+        g = PipeGraph(f"msoak{runs}", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+        op = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+              .with_key_by("key").with_tb_windows(win_us, slide_us)
+              .with_key_capacity(n_keys)
+              .with_mesh(mesh_shape=mesh_shape, fire_rounds=fire_rounds)
+              .build())
+        g.add_source(Source_Builder(src).with_output_batch_size(obs)
+                     .build()).add(op).add_sink(Sink_Builder(sink).build())
+        g.run()
+        # oracle: origin-anchored TB; only VALID (non-empty) windows
+        idx = [i for i in range(p1)] + \
+              [p1 + gap + j for j in range(p2)]
+        pane = int(np.gcd(win_us, slide_us))
+        win_p, slide_p = win_us // pane, slide_us // pane
+        panes = {}
+        for i in idx:
+            p = (i * ts_step) // pane
+            panes.setdefault(p, 0.0)
+            panes[p] += i + 1
+        exp1 = {}
+        max_p = max(panes)
+        w = 0
+        while w * slide_p <= max_p:
+            s = sum(v for p, v in panes.items()
+                    if w * slide_p <= p < w * slide_p + win_p)
+            if s:
+                exp1[w] = s
+            w += 1
+        exp = {(k, w): v for k in keymap for w, v in exp1.items()}
+        if rows != exp or dups[0]:
+            fails += 1
+            miss = {k: (exp.get(k), rows.get(k))
+                    for k in set(exp) | set(rows)
+                    if exp.get(k) != rows.get(k)}
+            print(f"MISMATCH run={runs} cfg={cfg} dups={dups[0]} "
+                  f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"mesh soak done: {runs} runs, {fails} failures", flush=True)
+sys.exit(1 if fails else 0)
