@@ -1,7 +1,7 @@
 """Randomized differential soak: random FFAT_TPU configs (TB/CB, win,
 slide, keys, parallelism, batch sizes, watermark cadence, lateness)
 through full PipeGraphs vs the canonical window model. Prints any
-mismatching config; exits 0 after the time budget with a summary."""
+mismatching config; exits nonzero iff any run mismatched or crashed."""
 import os
 import random
 import sys
@@ -16,7 +16,7 @@ from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
                           Source_Builder, TimePolicy)
 from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
 
-from common import TupleT, expected_windows
+from common import DictWinCollector, TupleT, expected_windows
 
 t_end = time.monotonic() + BUDGET_S
 runs = fails = 0
@@ -38,11 +38,9 @@ while time.monotonic() < t_end:
     nwpb = rng.choice([4, 8, 16])
     lateness = rng.choice([0, 0, 0, 200])
     wm_every = rng.choice([1, 1, 4, 16])
-    seed = rng.randrange(1 << 30)
 
     def make_src(nk, sl):
         def src(shipper, ctx):
-            r2 = random.Random(seed + ctx.get_replica_index())
             for i in range(sl):
                 ts = i * ts_step
                 for k in range(ctx.get_replica_index(), nk,
@@ -52,18 +50,7 @@ while time.monotonic() < t_end:
                     shipper.set_next_watermark(ts)
         return src
 
-    import threading
-    lock = threading.Lock()
-    results, dups = {}, [0]
-
-    def sink(r):
-        if r is None:
-            return
-        with lock:
-            kk = (r["key"], r["wid"])
-            if kk in results:
-                dups[0] += 1
-            results[kk] = r["value"] if r["valid"] else None
+    coll = DictWinCollector()
 
     cfg = dict(n_keys=n_keys, stream=stream_len, ts_step=ts_step,
                cb=cb, win=win, slide=slide, obs=obs, src_par=src_par,
@@ -81,7 +68,7 @@ while time.monotonic() < t_end:
         g.add_source(Source_Builder(make_src(n_keys, stream_len))
                      .with_parallelism(src_par)
                      .with_output_batch_size(obs).build()
-                     ).add(b.build()).add_sink(Sink_Builder(sink).build())
+                     ).add(b.build()).add_sink(Sink_Builder(coll.sink).build())
         g.run()
         seqs = {k: [(i + 1 + k, i * ts_step) for i in range(stream_len)]
                 for k in range(n_keys)}
@@ -89,12 +76,12 @@ while time.monotonic() < t_end:
                                lambda v: sum(v) if v else None)
         # lateness/wm cadence never drop in-order streams (ts monotone),
         # so results must match exactly
-        if results != exp or dups[0]:
+        if coll.results != exp or coll.dups:
             fails += 1
-            miss = {k: (exp.get(k), results.get(k))
-                    for k in set(exp) | set(results)
-                    if exp.get(k) != results.get(k)}
-            print(f"MISMATCH run={runs} cfg={cfg} dups={dups[0]} "
+            miss = {k: (exp.get(k), coll.results.get(k))
+                    for k in set(exp) | set(coll.results)
+                    if exp.get(k) != coll.results.get(k)}
+            print(f"MISMATCH run={runs} cfg={cfg} dups={coll.dups} "
                   f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
     except Exception as e:
         fails += 1

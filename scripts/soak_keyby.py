@@ -1,0 +1,109 @@
+"""Randomized keyby-staging soak: random key TYPES (dense int, sparse
+int, str, bytes), fan-outs, batch sizes, and MIXED push()/push_columns()
+staging through a STATEFUL keyed Map_TPU (running per-key counter written
+into the v field). A
+key whose tuples split across replicas gets two independent counters,
+so its observed max counter under-counts — exactly the routing
+consistency the round-4 FNV/scalar key routing must guarantee. The
+numeric ``kid`` label rides the schema; the routing key ``k`` is the
+non-numeric host-metadata extractor under test."""
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "900"))
+
+import numpy as np
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import Map_TPU_Builder
+from windflow_tpu.tpu.schema import TupleSchema
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "2"))
+
+while time.monotonic() < t_end:
+    runs += 1
+    n_keys = rng.choice([1, 3, 8, 40])
+    kind = rng.choice(["dense", "sparse", "str", "bytes"])
+    if kind == "dense":
+        keys = list(range(n_keys))
+    elif kind == "sparse":
+        keys = [(k * 2_654_435_761 - 7_000_000_000) * (5 + k)
+                for k in range(n_keys)]
+    elif kind == "str":
+        keys = [f"sym-{k:05d}" for k in range(n_keys)]
+    else:
+        keys = [f"b{k:04d}".encode() for k in range(n_keys)]
+    op_par = rng.choice([1, 2, 3])
+    obs = rng.choice([16, 64, 256])
+    n_rows = rng.choice([400, 1500])
+    mix = rng.random() < 0.6  # mix per-row and columnar staging
+    seed = rng.randrange(1 << 30)
+
+    def make_rows():
+        r2 = random.Random(seed)
+        return [r2.randrange(n_keys) for _ in range(n_rows)]
+
+    def src(shipper, ctx):
+        idx = make_rows()
+        half = n_rows // 2 if mix else n_rows
+        for j in idx[:half]:
+            shipper.push({"k": keys[j], "kid": j, "v": 1.0})
+        if half < n_rows:
+            kcol = np.array([keys[j] for j in idx[half:]])
+            shipper.push_columns(
+                {"k": kcol,
+                 "kid": np.array(idx[half:], np.int64),
+                 "v": np.ones(n_rows - half, np.float32)})
+
+    lock = threading.Lock()
+    max_n = {}
+
+    def sink(r):
+        if r is None:
+            return
+        with lock:
+            kid = int(r["kid"])
+            max_n[kid] = max(max_n.get(kid, 0), int(r["v"]))
+
+    cfg = dict(n_keys=n_keys, kind=kind, op_par=op_par, obs=obs,
+               n_rows=n_rows, mix=mix)
+    try:
+        import jax.numpy as jnp
+
+        g = PipeGraph(f"ksoak{runs}", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        m = (Map_TPU_Builder(
+                lambda row, st: ({**row, "v": st["n"] + 1.0},
+                                 {"n": st["n"] + 1}))
+             .with_state({"n": jnp.int32(0)})
+             .with_key_by("k")
+             .with_schema(TupleSchema({"kid": np.int64, "v": np.float32}))
+             .with_parallelism(op_par).build())
+        g.add_source(Source_Builder(src).with_output_batch_size(obs)
+                     .build()).add(m).add_sink(Sink_Builder(sink).build())
+        g.run()
+        idx = make_rows()
+        exp = {}
+        for j in idx:
+            exp[j] = exp.get(j, 0) + 1
+        got = {j: max_n.get(j, 0) for j in exp}
+        if got != exp:
+            fails += 1
+            miss = {j: (exp[j], got[j]) for j in exp if exp[j] != got[j]}
+            print(f"MISMATCH run={runs} cfg={cfg} "
+                  f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"keyby soak done: {runs} runs, {fails} failures", flush=True)
+sys.exit(1 if fails else 0)

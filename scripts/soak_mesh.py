@@ -1,7 +1,7 @@
 """Randomized differential soak for Ffat_Windows_Mesh: random mesh
 shapes, sparse/negative keys, win/slide, watermark cadence, IDLE GAPS
 (the round-4 fast-forward surface), batch sizes — vs an origin-anchored
-oracle. Prints mismatching configs; summary at the end."""
+oracle. Prints mismatching configs; exits nonzero iff any run failed."""
 import os
 import random
 import sys

@@ -140,3 +140,23 @@ class WinCollector:
             if k in self.results:
                 self.dups += 1
             self.results[k] = r.value
+
+
+class DictWinCollector:
+    """WinCollector for dict-shaped window rows ({key, wid, valid,
+    value}): stores value (None when invalid), counts duplicates."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.results = {}
+        self.dups = 0
+
+    def sink(self, r):
+        if r is None:
+            return
+        with self._lock:
+            k = (r["key"], r["wid"])
+            if k in self.results:
+                self.dups += 1
+            self.results[k] = r["value"] if r["valid"] else None

@@ -11,7 +11,8 @@ from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
                           Source_Builder, TimePolicy)
 from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
 
-from common import TupleT, expected_windows, rand_degree
+from common import (DictWinCollector, TupleT, expected_windows,
+                    rand_degree)
 
 N_KEYS = 5
 STREAM_LEN = 120
@@ -38,23 +39,6 @@ def model_seqs(n_keys, stream_len):
 
 def sum_or_none(vals):
     return sum(vals) if vals else None
-
-
-class DictWinCollector:
-    def __init__(self):
-        import threading
-        self._lock = threading.Lock()
-        self.results = {}
-        self.dups = 0
-
-    def sink(self, r):
-        if r is None:
-            return
-        with self._lock:
-            k = (r["key"], r["wid"])
-            if k in self.results:
-                self.dups += 1
-            self.results[k] = r["value"] if r["valid"] else None
 
 
 def run_ffat_tpu(win, slide, win_type_cb, n_keys=N_KEYS,
