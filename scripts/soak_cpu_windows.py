@@ -1,0 +1,119 @@
+"""Randomized differential soak for the CPU window strategies —
+{Keyed, Parallel, Paned, MapReduce} × {TB, CB} × {DEFAULT,
+DETERMINISTIC} × incremental/whole-window × random degrees, vs the
+canonical model. Prints mismatching configs; exits nonzero iff any run
+mismatched or crashed."""
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "900"))
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder,
+                          MapReduce_Windows_Builder, Paned_Windows_Builder,
+                          Parallel_Windows_Builder, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy, WindFlowError)
+
+from common import TupleT, WinCollector, expected_windows
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = skipped = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "3"))
+
+BUILDERS = {
+    "keyed": Keyed_Windows_Builder,
+    "parallel": Parallel_Windows_Builder,
+    "paned": Paned_Windows_Builder,
+    "mapreduce": MapReduce_Windows_Builder,
+}
+
+while time.monotonic() < t_end:
+    runs += 1
+    strat = rng.choice(list(BUILDERS))
+    mode = rng.choice([ExecutionMode.DEFAULT, ExecutionMode.DETERMINISTIC])
+    cb = rng.random() < 0.45
+    n_keys = rng.choice([1, 2, 5, 9])
+    stream_len = rng.choice([40, 60, 90])
+    ts_step = rng.choice([97, 137, 211])
+    if cb:
+        win, slide = rng.randint(2, 16), rng.randint(1, 10)
+    else:
+        win = rng.choice([300, 700, 1000, 1600])
+        slide = rng.choice([200, 400, 800, 1300])
+    incremental = rng.random() < 0.4
+    src_par = rng.choice([1, 1, 2])
+    op_par = rng.choice([1, 2, 3])
+    cfg = dict(strat=strat, mode=mode.name, cb=cb, n_keys=n_keys,
+               stream=stream_len, ts_step=ts_step, win=win, slide=slide,
+               inc=incremental, src_par=src_par, op_par=op_par)
+
+    def src(shipper, ctx):
+        for i in range(stream_len):
+            ts = i * ts_step
+            for k in range(ctx.get_replica_index(), n_keys,
+                           ctx.get_parallelism()):
+                shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts), ts)
+            shipper.set_next_watermark(ts)
+
+    try:
+        coll = WinCollector()
+        g = PipeGraph(f"wsoak{runs}", mode, TimePolicy.EVENT_TIME)
+        B = BUILDERS[strat]
+        two_stage = strat in ("paned", "mapreduce")
+        if two_stage:
+            # PLQ + WLQ pair (pane partials, window merge)
+            if incremental:
+                b = (B(lambda t, acc: acc + t.value,
+                       lambda v, acc: acc + v)
+                     .incremental(0).incremental_stage2(0))
+            else:
+                b = B(lambda ws: sum(w.value for w in ws),
+                      lambda vals: sum(vals))
+        else:
+            b = B((lambda t, acc: acc + t.value) if incremental
+                  else (lambda ws: sum(w.value for w in ws)))
+            if incremental:
+                b = b.incremental(0)
+        b = b.with_key_by(lambda t: t.key)
+        b = b.with_cb_windows(win, slide) if cb \
+            else b.with_tb_windows(win, slide)
+        b = (b.with_parallelism(op_par, rng.choice([1, 2]))
+             if two_stage else b.with_parallelism(op_par))
+        g.add_source(Source_Builder(src).with_parallelism(src_par).build()
+                     ).add(b.build()
+                           ).add_sink(Sink_Builder(coll.sink).build())
+        g.run()
+        exp = expected_windows(
+            {k: [(i + 1 + k, i * ts_step) for i in range(stream_len)]
+             for k in range(n_keys)}, win, slide, cb,
+            lambda v: sum(v))
+        if coll.results != exp or coll.dups:
+            fails += 1
+            miss = {k: (exp.get(k), coll.results.get(k))
+                    for k in set(exp) | set(coll.results)
+                    if exp.get(k) != coll.results.get(k)}
+            print(f"MISMATCH run={runs} cfg={cfg} dups={coll.dups} "
+                  f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
+    except WindFlowError as e:
+        # documented rejections (e.g. Parallel/Paned CB+DEFAULT) are
+        # expected config errors, not failures
+        if ("DEFAULT" in str(e) or "CB" in str(e) or "mandatory" in str(e)
+                or "sliding windows" in str(e)):
+            skipped += 1
+        else:
+            fails += 1
+            print(f"CRASH run={runs} cfg={cfg}: WindFlowError: {e}",
+                  flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"cpu-window soak done: {runs} runs ({skipped} rejected configs), "
+      f"{fails} failures", flush=True)
+sys.exit(1 if fails else 0)
