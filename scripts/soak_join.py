@@ -1,0 +1,104 @@
+"""Randomized differential soak for Interval_Join: random key counts,
+stream lengths/steps (including identical-ts collisions), asymmetric
+bounds (negative-lower, zero-width), KP/DP modes, execution modes, and
+random degrees — every emitted pair set must equal the brute-force
+model. Prints mismatching configs; exits nonzero iff any run failed."""
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "600"))
+
+from windflow_tpu import (ExecutionMode, Interval_Join_Builder, PipeGraph,
+                          Sink_Builder, Source_Builder, TimePolicy)
+
+from common import TupleT
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "4"))
+
+while time.monotonic() < t_end:
+    runs += 1
+    n_keys = rng.choice([1, 2, 4, 7])
+    len_a = rng.choice([20, 40, 60])
+    len_b = rng.choice([20, 50])
+    step_a = rng.choice([50, 83, 100, 137])
+    step_b = rng.choice([50, 83, 100])
+    lower = rng.choice([0, 60, 120, 250])
+    upper = rng.choice([0, 90, 200])
+    kp = rng.random() < 0.5
+    mode = rng.choice([ExecutionMode.DEFAULT, ExecutionMode.DETERMINISTIC])
+    pa = rng.choice([1, 2])
+    pb = rng.choice([1, 2])
+    pj = rng.choice([1, 2, 3])
+    cfg = dict(n_keys=n_keys, len_a=len_a, len_b=len_b, step_a=step_a,
+               step_b=step_b, lower=lower, upper=upper,
+               kp=kp, mode=mode.name, pa=pa, pb=pb, pj=pj)
+
+    def make_src(length, step, base):
+        def src(shipper, ctx):
+            for i in range(length):
+                ts = i * step
+                for k in range(ctx.get_replica_index(), n_keys,
+                               ctx.get_parallelism()):
+                    shipper.push_with_timestamp(TupleT(k, base + i, ts), ts)
+                shipper.set_next_watermark(ts)
+        return src
+
+    class Coll:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pairs = []
+
+        def sink(self, r):
+            if r is not None:
+                with self._lock:
+                    self.pairs.append(r)
+
+    coll = Coll()
+    try:
+        g = PipeGraph(f"jsoak{runs}", mode, TimePolicy.EVENT_TIME)
+        a = (Source_Builder(make_src(len_a, step_a, 1000))
+             .with_parallelism(pa).build())
+        b = (Source_Builder(make_src(len_b, step_b, 2000))
+             .with_parallelism(pb).build())
+        jb = (Interval_Join_Builder(lambda x, y: (x.key, x.value, y.value))
+              .with_key_by(lambda t: t.key)
+              .with_boundaries(lower, upper)
+              .with_parallelism(pj))
+        jb = jb.with_kp_mode() if kp else jb.with_dp_mode()
+        mpa = g.add_source(a)
+        mpb = g.add_source(b)
+        mpa.merge(mpb).add(jb.build()).add_sink(
+            Sink_Builder(coll.sink).build())
+        g.run()
+        exp = set()
+        for k in range(n_keys):
+            for i in range(len_a):
+                ta = i * step_a
+                for j in range(len_b):
+                    tb = j * step_b
+                    if ta - lower <= tb <= ta + upper:
+                        exp.add((k, 1000 + i, 2000 + j))
+        got = sorted(coll.pairs)
+        if got != sorted(exp) :
+            fails += 1
+            gs = set(got)
+            print(f"MISMATCH run={runs} cfg={cfg} "
+                  f"missing={sorted(exp - gs)[:5]} "
+                  f"extra={sorted(gs - exp)[:5]} "
+                  f"dups={len(got) - len(gs)}", flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"join soak done: {runs} runs, {fails} failures", flush=True)
+sys.exit(1 if fails else 0)
