@@ -364,3 +364,134 @@ def test_mesh_outrunning_watermark_beyond_cap_raises():
         Sink_Builder(lambda r, c: None).build())
     with pytest.raises(WindFlowError, match="ring"):
         graph.run()
+
+
+def _run_late_policy_pipeline(late_policy):
+    """Fire w0/w1 first (nf -> 2 panes), then deliver a LATE tuple at
+    pane 2 — inside the last fired window (w1 spans panes 1..4) but also
+    inside open windows (w2 spans 2..5). The two policies must diverge
+    exactly there (advisor r4 finding #1): "keep_open" folds it into w2,
+    "ref_fired" drops it like ``wf/window_replica.hpp:257-258``."""
+    coll = Collector()
+    graph = PipeGraph(f"mesh_late_{late_policy}", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        for p in range(8):          # panes 0..7 (pane_len = 1 µs)
+            shipper.push_with_timestamp({"key": 0, "value": 1.0}, p)
+        shipper.set_next_watermark(5)
+        # carries wm=5: the step fires w0 (end 4) and w1 (end 5) -> nf=2
+        shipper.push_with_timestamp({"key": 0, "value": 0.0}, 7)
+        # LATE: pane 2 in [nf, nf + win - slide) = [2, 5)
+        shipper.push_with_timestamp({"key": 0, "value": 100.0}, 2)
+
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b: {"value": a["value"] + b["value"]})
+          .with_key_by("key").with_tb_windows(4, 1)
+          .with_key_capacity(1)
+          .with_mesh(late_policy=late_policy).build())
+    graph.add_source(Source_Builder(src).with_output_batch_size(1).build()
+                     ).add(op).add_sink(Sink_Builder(coll.sink).build())
+    graph.run()
+    return {k: v for k, v in coll.rows.items() if v is not None}
+
+
+@needs_multi
+@pytest.mark.parametrize("late_policy,w2", [("keep_open", 104.0),
+                                            ("ref_fired", 4.0)])
+def test_mesh_late_policy(late_policy, w2):
+    got = _run_late_policy_pipeline(late_policy)
+    # w0/w1 fired BEFORE the late tuple arrived: identical either way
+    assert got[(0, 0)] == 4.0 and got[(0, 1)] == 4.0
+    # the discriminating window: open at arrival, spans the late pane
+    assert got[(0, 2)] == w2, got
+    # downstream windows never contain pane 2: identical either way
+    assert got[(0, 3)] == 4.0 and got[(0, 7)] == 1.0
+
+
+def test_mesh_late_policy_validation():
+    with pytest.raises(WindFlowError, match="late_policy"):
+        (Ffat_Windows_TPU_Builder(lambda f: f, lambda a, b: a)
+         .with_key_by("key").with_tb_windows(4, 1)
+         .with_mesh(late_policy="nope").build())
+
+
+def test_keymap_capacity_overflow_rolls_back():
+    """Advisor r4 finding #2: a key refused by on_new (capacity) must NOT
+    stay registered — a caught-and-retried batch would silently get an
+    out-of-range slot feeding device routing."""
+    from windflow_tpu.tpu.keymap import KeySlotMap
+    cap = 2
+
+    def on_new(key, slot):
+        if slot >= cap:
+            raise WindFlowError("over capacity")
+
+    m = KeySlotMap(on_new=on_new)
+    assert m.slot("a") == 0 and m.slot("b") == 1
+    for _ in range(2):          # the retry must raise AGAIN, not return 2
+        with pytest.raises(WindFlowError, match="capacity"):
+            m.slot("c")
+        assert len(m) == 2
+    # same contract through the vectorized int path (LUT miss loop)
+    m2 = KeySlotMap(on_new=on_new)
+    a = np.array([5, 9, 9])
+    assert list(m2.slots_of(a, a, 3)) == [0, 1, 1]
+    b = np.array([11])
+    for _ in range(2):
+        with pytest.raises(WindFlowError, match="capacity"):
+            m2.slots_of(b, b, 1)
+        assert len(m2) == 2
+
+
+@needs_multi
+def test_forest_int32_index_plane_guard():
+    """Advisor r4 finding #3: k_local * 2 * ring_panes must refuse loudly
+    when it would overflow the int32 flat-index plane (ring growth doubles
+    F through the same construction path)."""
+    from windflow_tpu.parallel import make_key_mesh, sharded_ffat_forest
+    mesh = make_key_mesh(8, shape=(8, 1))
+    with pytest.raises(ValueError, match="int32 index plane"):
+        sharded_ffat_forest(
+            mesh, lambda f: f, lambda a, b: a, n_keys=1 << 28,
+            win_panes=4, slide_panes=1, local_batch=8, fire_rounds=2,
+            ring_panes=64)
+
+
+@needs_multi
+def test_mesh_late_policy_hopping_windows_coincide():
+    """Hopping windows (slide > win): the ref_fired offset must clamp at
+    0, never below next_fire (an under-drop would fold tuples into
+    EVICTED ring leaves). Gap panes belong to no window, so the two
+    policies must produce identical results."""
+    def run(late_policy):
+        coll = Collector()
+        graph = PipeGraph(f"mesh_hop_{late_policy}", ExecutionMode.DEFAULT,
+                          TimePolicy.EVENT_TIME)
+
+        def src(shipper, ctx):
+            for p in range(12):       # win=1/slide=3 panes: gaps 1,2 etc.
+                shipper.push_with_timestamp({"key": 0, "value": 1.0}, p)
+            shipper.set_next_watermark(7)
+            shipper.push_with_timestamp({"key": 0, "value": 0.0}, 11)
+            # gap pane 4 (window starts: 0,3,6,9 with win=1): in no window,
+            # and below next_fire once w0/w1 fired
+            shipper.push_with_timestamp({"key": 0, "value": 100.0}, 4)
+
+        op = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+              .with_key_by("key").with_tb_windows(1, 3)
+              .with_key_capacity(1)
+              .with_mesh(late_policy=late_policy).build())
+        graph.add_source(
+            Source_Builder(src).with_output_batch_size(1).build()
+        ).add(op).add_sink(Sink_Builder(coll.sink).build())
+        graph.run()
+        return {k: v for k, v in coll.rows.items() if v is not None}
+
+    keep, ref = run("keep_open"), run("ref_fired")
+    assert keep == ref, (keep, ref)
+    # windows hold exactly their single start pane's value (no 100 leak)
+    assert all(v == 1.0 for v in keep.values()), keep

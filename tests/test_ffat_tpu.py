@@ -461,3 +461,32 @@ def test_ffat_tpu_deferred_rebuild_dataless_fire():
     expected = expected_windows(seqs, WIN_US, SLIDE_US, False, sum_or_none)
     assert coll.dups == 0
     assert coll.results == expected
+
+
+def test_key_growth_overflow_raise_before_mutate():
+    """A key-table growth that would overflow the int32 index plane must
+    raise BEFORE any bookkeeping mutates: KeySlotMap rolls back the slot
+    registration on refusal, so a caught-and-retried batch must find
+    UNCHANGED replica state — not a double-appended _out_keys_by_slot
+    shifting every later slot's original-key mapping."""
+    from windflow_tpu.basic import WindFlowError, WinType
+    from windflow_tpu.tpu.ffat_tpu import Ffat_Windows_TPU
+
+    op = Ffat_Windows_TPU(
+        lift=lambda f: {"v": f["v"]},
+        combine=lambda a, b: {"v": a["v"] + b["v"]},
+        key_extractor="key", win_len=4, slide_len=1,
+        win_type=WinType.TB, key_capacity=2, name="grow_guard")
+    op.build_replicas()
+    rep = op.replicas[0]
+    rep.F = 1 << 27          # forged: doubling K_cap 4 -> 8 overflows int32
+    for k in range(rep.K_cap):
+        rep._keymap.slot(1000 + k)
+    before = list(rep._out_keys_by_slot)
+    k_cap = rep.K_cap
+    for _ in range(2):       # the retry must fail IDENTICALLY
+        with pytest.raises(WindFlowError, match="int32 index plane"):
+            rep._keymap.slot(9999)
+        assert rep._out_keys_by_slot == before
+        assert rep.K_cap == k_cap
+        assert len(rep._keymap) == k_cap

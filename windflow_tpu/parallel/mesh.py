@@ -185,7 +185,8 @@ def sharded_keyby_window_step(mesh, n_keys: int, n_panes: int,
 
 def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
                         slide_panes: int, local_batch: int,
-                        fire_rounds: int = 2, ring_panes: int = 0):
+                        fire_rounds: int = 2, ring_panes: int = 0,
+                        late_policy: str = "keep_open"):
     """The FLAGSHIP operator sharded over the mesh: a FlatFAT forest whose
     key axis is block-sharded along ``'key'`` (shard s owns keys
     [s*k_local, (s+1)*k_local)), with ingestion data-parallel along
@@ -213,8 +214,14 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
       fired, results, res_valid, res_wid, n_tuples, n_late)``; results
       have shape (K_pad, fire_rounds) per lift field — window aggregates
       for each owned key, up to ``fire_rounds`` windows per step;
-      ``n_late`` counts tuples dropped by the per-key lateness rule
-      (pane < next_fire[key]: every window containing it already fired);
+      ``n_late`` counts tuples dropped by the per-key lateness rule —
+      under ``late_policy="keep_open"`` (default) a pane is late iff
+      EVERY window containing it has fired (pane < next_fire[key]); under
+      ``late_policy="ref_fired"`` it is the reference's exact bound
+      (``wf/window_replica.hpp:257-258``): late iff it falls anywhere
+      inside the key's last FIRED window (pane < next_fire + win - slide
+      once a window fired), i.e. the reference also drops tuples that
+      still belong to OPEN windows;
     - ``meta = (K_pad, k_local, global_batch)``.
     """
     import jax
@@ -237,6 +244,32 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
             f"sharded_ffat_forest: ring_panes must be a power of two >= "
             f"win_panes + fire_rounds*slide_panes (got F={F}, "
             f"win={win_panes}, rounds={fire_rounds}, slide={slide_panes})")
+    # int32 index-plane guard: the scatter uses flat indices up to
+    # k_local*2F (lkey*2F + F + leaf); ring GROWTH doubles F through this
+    # same construction path, so a large key_capacity times a grown ring
+    # must refuse loudly here rather than wrap int32 silently
+    if k_local * 2 * F > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"sharded_ffat_forest: k_local*2*ring_panes = {k_local * 2 * F}"
+            f" overflows the int32 index plane (k_local={k_local}, "
+            f"ring_panes={F}); shard over more 'key' devices or lower "
+            f"key_capacity/ring_panes")
+    if late_policy not in ("keep_open", "ref_fired"):
+        raise ValueError(
+            f"sharded_ffat_forest: late_policy must be 'keep_open' or "
+            f"'ref_fired' (got {late_policy!r})")
+    # static late-bound offset: 0 keeps tuples that still belong to open
+    # windows; win-slide reproduces the reference's fired-window bound
+    # (gated below on next_fire > 0 == "at least one window fired/skipped",
+    # matching the reference's last_lwid >= 0 gate). Dropping MORE tuples
+    # is always ring-safe (fewer leaf touches); the offset must never go
+    # NEGATIVE (hopping windows, slide > win: a bound below next_fire
+    # would admit tuples whose leaf slot is already evicted). Clamping to
+    # 0 loses nothing there — panes in [nf+win-slide, nf) fall in the
+    # gaps BETWEEN hopping windows and contribute to no window at all,
+    # so the two policies coincide for hopping windows.
+    LATE_OFF = max(0, win_panes - slide_panes) \
+        if late_policy == "ref_fired" else 0
     NNODES = 2 * F
     LOGQ = NNODES.bit_length()
     C = local_batch  # per-destination bucket capacity (masked)
@@ -305,13 +338,23 @@ def sharded_ffat_forest(mesh, lift, combine, n_keys: int, win_panes: int,
         # ---- route tuples to their key-owner shard (ICI all_to_all) ----
         recv_k, recv_p, recv_v, valid, lkey = _route_to_owners(
             ka, k_local, C, keys, panes, raw_vals)
-        # the reference's lateness rule, EXACT and per key
-        # (``wf/window_replica.hpp:258-268``: drop only tuples behind the
-        # last FIRED window): a pane is late iff every window containing
-        # it has fired, i.e. p < next_fire[key]. Late panes must also not
-        # touch the forest — their leaf slot may alias an evicted ring
-        # position. Counted and returned so the host can account drops.
-        late = valid & (recv_p < next_fire[lkey])
+        # per-key lateness rule. Default ("keep_open", LATE_OFF=0): a pane
+        # is late iff EVERY window containing it has fired (p < next_fire)
+        # — a deliberate LESS-LOSSY divergence from the reference, which
+        # also drops tuples inside the last fired window even when they
+        # still belong to open windows (``wf/window_replica.hpp:257-258``:
+        # index < win + last_lwid*slide, gated on last_lwid >= 0).
+        # "ref_fired" reproduces that bound exactly: next_fire > 0 means
+        # at least one window fired (or was skipped provably-empty, which
+        # the reference fires too), i.e. the last fired window ends at
+        # next_fire + win - slide. Late panes must also not touch the
+        # forest — their leaf slot may alias an evicted ring position.
+        # Counted and returned so the host can account drops.
+        nf_t = next_fire[lkey]
+        late_bound = nf_t
+        if LATE_OFF:
+            late_bound = nf_t + jnp.where(nf_t > 0, jnp.int32(LATE_OFF), 0)
+        late = valid & (recv_p < late_bound)
         valid = valid & ~late
         n_late = lax.psum(jnp.sum(late), ("key", "data"))
 

@@ -138,7 +138,8 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
     def with_mesh(self, n_devices: Optional[int] = None,
                   mesh_shape: Optional[tuple] = None,
                   local_batch: Optional[int] = None,
-                  fire_rounds: int = 4, ring_panes: int = 0):
+                  fire_rounds: int = 4, ring_panes: int = 0,
+                  late_policy: str = "keep_open"):
         """Shard the FlatFAT forest over a ('key','data') device mesh:
         ``build()`` returns the multi-chip ``Ffat_Windows_Mesh`` operator
         (keyby via ``lax.all_to_all`` over ICI, on-device fire control)
@@ -147,11 +148,16 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         only (CB needs a serialized per-key arrival counter — see
         PARITY.md); ARBITRARY int64 keys, densified to
         ``key_capacity`` slots by a host KeySlotMap (more distinct keys
-        than the capacity raise)."""
+        than the capacity raise). ``late_policy``: "keep_open" (default)
+        drops a tuple only when every window containing it already fired
+        (less lossy than the reference); "ref_fired" reproduces the
+        reference's fired-window bound exactly (drops tuples inside the
+        last fired window even when open windows still contain them)."""
         self._mesh_cfg = {"n_devices": n_devices, "mesh_shape": mesh_shape,
                           "local_batch": local_batch,
                           "fire_rounds": fire_rounds,
-                          "ring_panes": ring_panes}
+                          "ring_panes": ring_panes,
+                          "late_policy": late_policy}
         return self
 
     def build(self):

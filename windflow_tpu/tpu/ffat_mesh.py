@@ -31,10 +31,16 @@ Design (vs the single-chip ``tpu/ffat_tpu.py``):
   ``key_capacity`` raise loudly (``with_key_capacity`` is the knob).
   Non-integer key types stay single-chip-only: their per-row Python
   hashing would serialize the mesh's host control loop;
-- lateness is the reference's EXACT per-key rule, enforced on device: a
-  tuple is dropped (and counted ignored) iff every window containing its
-  pane has already fired for its key — ``pane < next_fire[key]``
-  (``wf/window_replica.hpp:258-268``); the only host-side drop is panes
+- lateness is a per-key rule enforced on device. The DEFAULT
+  (``late_policy="keep_open"``) drops a tuple (counted ignored) iff
+  every window containing its pane has already fired for its key —
+  ``pane < next_fire[key]`` — a deliberate LESS-LOSSY divergence from
+  the reference, which drops any tuple inside the last fired window
+  even when it still belongs to open windows
+  (``wf/window_replica.hpp:257-258``: ``index < win + last_lwid*slide``,
+  only once a window fired). ``late_policy="ref_fired"`` reproduces the
+  reference bound exactly (``pane < next_fire + win - slide`` once
+  ``next_fire > 0``). Either way the only host-side drop is panes
   below the first batch's slide-aligned rebase anchor, which the device
   pane domain cannot represent. Keys that go idle are fast-forwarded past
   the frontier inside the step (their skipped windows are provably
@@ -80,6 +86,7 @@ class Ffat_Windows_Mesh(TPUOperatorBase):
                  local_batch: Optional[int] = None,
                  fire_rounds: int = 4,
                  ring_panes: int = 0,
+                 late_policy: str = "keep_open",
                  schema: Optional[TupleSchema] = None) -> None:
         if key_extractor is None:
             raise WindFlowError(f"{name}: requires a key extractor")
@@ -103,8 +110,13 @@ class Ffat_Windows_Mesh(TPUOperatorBase):
         self.n_devices = n_devices
         self.mesh_shape = mesh_shape
         self.local_batch = local_batch
+        if late_policy not in ("keep_open", "ref_fired"):
+            raise WindFlowError(
+                f"{name}: late_policy must be 'keep_open' or 'ref_fired' "
+                f"(got {late_policy!r})")
         self.fire_rounds = max(1, fire_rounds)
         self.ring_panes = ring_panes
+        self.late_policy = late_policy
         self.pane_len = math.gcd(win_len, slide_len)
 
     def build_replicas(self) -> None:
@@ -196,7 +208,8 @@ class FfatMeshReplica(TPUReplicaBase):
                 self._mesh, op.lift, op.combine, n_keys=op.key_capacity,
                 win_panes=self.win_units, slide_panes=self.slide_units,
                 local_batch=self._local_batch,
-                fire_rounds=op.fire_rounds, ring_panes=ring_panes)
+                fire_rounds=op.fire_rounds, ring_panes=ring_panes,
+                late_policy=op.late_policy)
         except ValueError as e:  # config validation -> framework error
             raise WindFlowError(f"{op.name}: {e}") from None
 
@@ -237,8 +250,9 @@ class FfatMeshReplica(TPUReplicaBase):
         panes = panes - self._pane_base
         # frontier: the single-chip convention ((wm - lateness) // pane)
         self._advance_frontier(self._rebased_frontier())
-        # the EXACT lateness rule (drop iff behind the key's last fired
-        # window) lives ON DEVICE as a per-key mask against next_fire;
+        # the per-key lateness rule (late_policy: "keep_open" drops iff
+        # every containing window fired; "ref_fired" also drops inside
+        # the last fired window) lives ON DEVICE as a mask on next_fire;
         # the host only drops panes below the rebase anchor (the first
         # batch's slide-aligned min pane — the device pane domain cannot
         # represent them; counted ignored, a documented anchor divergence)

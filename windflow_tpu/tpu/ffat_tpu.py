@@ -173,13 +173,15 @@ class FfatTPUReplica(TPUReplicaBase):
         M = self.K_cap * self.F
         return M, (np.int16 if M < 2**15 - 1 else np.int32)
 
-    def _check_index_plane(self) -> None:
+    def _check_index_plane(self, k_cap: int = 0) -> None:
         """Every forest index (host composite sort, device scatter/evict
         flat ids) lives in int32; enforced at init and after any growth —
-        in BOTH segmentation modes."""
-        if self.K_cap * 2 * self.F >= 2**31 - 1:
+        in BOTH segmentation modes. ``k_cap`` checks a PROSPECTIVE
+        capacity before mutating toward it."""
+        k = k_cap or self.K_cap
+        if k * 2 * self.F >= 2**31 - 1:
             raise WindFlowError(
-                f"{self.op.name}: K_cap*2F = {self.K_cap * 2 * self.F} "
+                f"{self.op.name}: K_cap*2F = {k * 2 * self.F} "
                 "overflows the int32 index plane; reduce key_capacity or "
                 "the window/slide ratio")
 
@@ -539,7 +541,16 @@ class FfatTPUReplica(TPUReplicaBase):
     # host control plane
     # ==================================================================
     def _on_new_key(self, key, s: int) -> None:
-        """KeySlotMap callback: per-slot bookkeeping for a fresh key."""
+        """KeySlotMap callback: per-slot bookkeeping for a fresh key.
+        RAISE-BEFORE-MUTATE: KeySlotMap.slot registers the key only when
+        this returns, so a refusal (index-plane overflow on growth) must
+        fire before any bookkeeping mutates — a caught-and-retried batch
+        would otherwise double-append ``_out_keys_by_slot`` and shift
+        every later slot's original-key mapping."""
+        if s >= self.K_cap:
+            # slots are sequential (s == len(map)), so one doubling
+            # always covers s; validate the doubled plane FIRST
+            self._check_index_plane(self.K_cap * 2)
         self._saw_new_key = True
         self._out_keys_by_slot.append(key)
         if s >= self.K_cap:

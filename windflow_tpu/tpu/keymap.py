@@ -30,9 +30,14 @@ class KeySlotMap:
     def slot(self, key) -> int:
         s = self.slot_of_key.get(key)
         if s is None:
-            s = self.slot_of_key[key] = len(self.slot_of_key)
+            s = len(self.slot_of_key)
             if self._on_new is not None:
+                # on_new may refuse the key (capacity); it must run BEFORE
+                # registration so a raise leaves no stale entry that a
+                # caught-and-retried batch would silently reuse with an
+                # out-of-range slot
                 self._on_new(key, s)
+            self.slot_of_key[key] = s
         return s
 
     def slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
