@@ -490,3 +490,77 @@ def test_key_growth_overflow_raise_before_mutate():
         assert rep._out_keys_by_slot == before
         assert rep.K_cap == k_cap
         assert len(rep._keymap) == k_cap
+    # ring growth must refuse BEFORE mutating F as well: a caught
+    # refusal after mutation would leave a wrapped index plane that no
+    # later per-batch guard re-checks
+    op2 = Ffat_Windows_TPU(
+        lift=lambda f: {"v": f["v"]},
+        combine=lambda a, b: {"v": a["v"] + b["v"]},
+        key_extractor="key", win_len=4, slide_len=1,
+        win_type=WinType.TB, key_capacity=2, name="ring_guard")
+    op2.build_replicas()
+    rep2 = op2.replicas[0]
+    rep2.K_cap = 1 << 26     # forged: F 32 -> 128 would give 2^34 indices
+    f_before = rep2.F
+    for _ in range(2):
+        with pytest.raises(WindFlowError, match="int32 index plane"):
+            rep2._grow_ring(1 << 6)
+        assert rep2.F == f_before
+
+
+def test_growth_build_then_commit(monkeypatch):
+    """Growth must BUILD-THEN-COMMIT: an allocation failure mid-growth
+    (injected here in place of a device OOM) leaves the replica in its
+    exact pre-growth state, and the retry succeeds cleanly — no
+    half-grown K_cap/F against old-shaped trees, no double-appended
+    key bookkeeping."""
+    import jax
+    import numpy as np
+
+    from windflow_tpu.basic import WinType
+    from windflow_tpu.tpu.ffat_tpu import Ffat_Windows_TPU
+
+    def mkop(name):
+        op = Ffat_Windows_TPU(
+            lift=lambda f: {"v": f["v"]},
+            combine=lambda a, b: {"v": a["v"] + b["v"]},
+            key_extractor="key", win_len=4, slide_len=1,
+            win_type=WinType.TB, key_capacity=2, name=name)
+        op.build_replicas()
+        return op.replicas[0]
+
+    def boom(*a, **k):
+        raise RuntimeError("injected alloc failure")
+
+    # ---- ring growth ----
+    rep = mkop("rg_commit")
+    rep._ensure_forest({"v": np.zeros(1)})
+    trees_before, F_before = rep.trees, rep.F
+    monkeypatch.setattr(jax.tree_util, "tree_map", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        rep._grow_ring(1 << 6)
+    assert rep.F == F_before and rep.trees is trees_before
+    monkeypatch.undo()
+    rep._grow_ring(1 << 6)
+    assert rep.F == 128 and rep.trees is not trees_before
+
+    # ---- key growth via _on_new_key ----
+    rep2 = mkop("kg_commit")
+    rep2._ensure_forest({"v": np.zeros(1)})
+    for k in range(rep2.K_cap):
+        rep2._keymap.slot(100 + k)
+    cap_before = rep2.K_cap
+    keys_before = list(rep2._out_keys_by_slot)
+    monkeypatch.setattr(jax.tree_util, "tree_map", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        rep2._keymap.slot(999)
+    assert rep2.K_cap == cap_before
+    assert rep2._out_keys_by_slot == keys_before
+    assert len(rep2._keymap) == cap_before
+    assert rep2.trees["v"].shape[0] == cap_before
+    monkeypatch.undo()
+    s = rep2._keymap.slot(999)            # retry succeeds from scratch
+    assert s == cap_before
+    assert rep2.K_cap == 2 * cap_before
+    assert rep2._out_keys_by_slot[-1] == 999
+    assert rep2.trees["v"].shape[0] == 2 * cap_before

@@ -173,15 +173,18 @@ class FfatTPUReplica(TPUReplicaBase):
         M = self.K_cap * self.F
         return M, (np.int16 if M < 2**15 - 1 else np.int32)
 
-    def _check_index_plane(self, k_cap: int = 0) -> None:
+    def _check_index_plane(self, k_cap: int = 0, f: int = 0) -> None:
         """Every forest index (host composite sort, device scatter/evict
-        flat ids) lives in int32; enforced at init and after any growth —
-        in BOTH segmentation modes. ``k_cap`` checks a PROSPECTIVE
-        capacity before mutating toward it."""
+        flat ids) lives in int32; enforced at init and BEFORE any growth
+        commits — in BOTH segmentation modes. ``k_cap``/``f`` check a
+        PROSPECTIVE capacity/ring before mutating toward it (growth must
+        raise-before-mutate: a caught refusal mid-growth would leave a
+        wrapped index plane that no later per-batch guard re-checks)."""
         k = k_cap or self.K_cap
-        if k * 2 * self.F >= 2**31 - 1:
+        ff = f or self.F
+        if k * 2 * ff >= 2**31 - 1:
             raise WindFlowError(
-                f"{self.op.name}: K_cap*2F = {k * 2 * self.F} "
+                f"{self.op.name}: K_cap*2F = {k * 2 * ff} "
                 "overflows the int32 index plane; reduce key_capacity or "
                 "the window/slide ratio")
 
@@ -549,12 +552,13 @@ class FfatTPUReplica(TPUReplicaBase):
         every later slot's original-key mapping."""
         if s >= self.K_cap:
             # slots are sequential (s == len(map)), so one doubling
-            # always covers s; validate the doubled plane FIRST
+            # always covers s; validate the doubled plane FIRST, and
+            # grow BEFORE any bookkeeping mutates (growth itself can
+            # fail, e.g. device OOM reallocating the doubled forest)
             self._check_index_plane(self.K_cap * 2)
+            self._grow_keys()
         self._saw_new_key = True
         self._out_keys_by_slot.append(key)
-        if s >= self.K_cap:
-            self._grow_keys()
         if self._keys_all_int and isinstance(key, int):
             self._keys_np[s] = key
         else:
@@ -565,39 +569,57 @@ class FfatTPUReplica(TPUReplicaBase):
         return self._keymap.slots_of(keys, keys_arr, n)
 
     def _grow_keys(self) -> None:
+        """BUILD-THEN-COMMIT: every fallible step (including the device
+        reallocation of the doubled forest) runs into locals first; the
+        replica mutates only after all of them succeeded, so a caught
+        growth failure leaves fully consistent pre-growth state for the
+        retry (which re-enters growth from scratch)."""
         import jax
         import jax.numpy as jnp
         old = self.K_cap
-        self.K_cap *= 2
+        new_cap = old * 2
+        grown = {}
         for name, fill in (("next_fire", 0), ("fired", 0),
                            ("max_leaf", -1), ("count", 0),
                            ("_keys_np", 0)):
             arr = getattr(self, name)
-            grown = np.full(self.K_cap, fill, dtype=arr.dtype)
-            grown[:old] = arr
-            setattr(self, name, grown)
+            g = np.full(new_cap, fill, dtype=arr.dtype)
+            g[:old] = arr
+            grown[name] = g
+        new_trees = new_tvalid = None
         if self.trees is not None:
-            self.trees = jax.tree_util.tree_map(
-                lambda t: jnp.zeros((self.K_cap,) + t.shape[1:], t.dtype)
+            new_trees = jax.tree_util.tree_map(
+                lambda t: jnp.zeros((new_cap,) + t.shape[1:], t.dtype)
                 .at[:old].set(t), self.trees)
-            self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool
-                                    ).at[:old].set(self.tvalid)
+            new_tvalid = jnp.zeros((new_cap, 2 * self.F), bool
+                                   ).at[:old].set(self.tvalid)
+        self.K_cap = new_cap
+        for name, g in grown.items():
+            setattr(self, name, g)
+        if new_trees is not None:
+            self.trees, self.tvalid = new_trees, new_tvalid
         self._ktable_dirty = True
-        self._check_index_plane()
 
     def _grow_ring(self, needed_span: int) -> None:
+        """BUILD-THEN-COMMIT, like ``_grow_keys`` (F and the migrated
+        forest commit together, after the fallible allocations)."""
         import jax
         import jax.numpy as jnp
         old_F = self.F
-        while needed_span >= self.F:
-            self.F *= 2
-        new_F = self.F
+        new_F = old_F
+        while needed_span >= new_F:
+            new_F *= 2
+        # prospective check BEFORE mutating F or the forest: a caught
+        # refusal after mutation would leave a wrapped index plane that
+        # no later per-batch guard re-checks
+        self._check_index_plane(f=new_F)
         if self.trees is None:
+            self.F = new_F
             return
         old_trees, old_valid = self.trees, self.tvalid
-        self.trees = jax.tree_util.tree_map(
+        new_trees = jax.tree_util.tree_map(
             lambda t: jnp.zeros((self.K_cap, 2 * new_F), t.dtype), old_trees)
-        self.tvalid = jnp.zeros((self.K_cap, 2 * new_F), bool)
+        new_tvalid = jnp.zeros((self.K_cap, 2 * new_F), bool)
         src_rows, src_cols, dst_cols = [], [], []
         for _, s in self.slot_of_key.items():
             for p in range(int(self.next_fire[s]), int(self.max_leaf[s]) + 1):
@@ -607,14 +629,15 @@ class FfatTPUReplica(TPUReplicaBase):
         if src_rows:
             sr, sc, dc = (np.asarray(src_rows), np.asarray(src_cols),
                           np.asarray(dst_cols))
-            self.trees = jax.tree_util.tree_map(
+            new_trees = jax.tree_util.tree_map(
                 lambda new, old: new.at[sr, dc].set(old[sr, sc]),
-                self.trees, old_trees)
-            self.tvalid = self.tvalid.at[sr, dc].set(old_valid[sr, sc])
+                new_trees, old_trees)
+            new_tvalid = new_tvalid.at[sr, dc].set(old_valid[sr, sc])
+        self.F = new_F
+        self.trees, self.tvalid = new_trees, new_tvalid
         # only leaves were carried over: internal levels need a rebuild
         # before any fire-only program may query them
         self._rebuild_dirty = True
-        self._check_index_plane()
 
     def _ensure_forest(self, sample_fields) -> None:
         if self.trees is not None:
