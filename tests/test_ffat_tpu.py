@@ -564,3 +564,60 @@ def test_growth_build_then_commit(monkeypatch):
     assert rep2.K_cap == 2 * cap_before
     assert rep2._out_keys_by_slot[-1] == 999
     assert rep2.trees["v"].shape[0] == 2 * cap_before
+
+
+def test_ffat_tpu_composite_key_columnar_pipeline():
+    """push_columns with a COMPOSITE field-tuple key (the YSB join-key
+    shape, with_key_by(("c", "a"))) -> keyed FFAT_TPU -> sink: routing
+    rides the stacked-column FNV (no per-row hash), the structured key
+    metadata feeds the KeySlotMap as tuples, and every (c, a, wid) sum
+    matches the oracle. The key rides the lift output (composite keys
+    are host metadata, not a device column)."""
+    import threading
+    import numpy as np
+    from windflow_tpu import Source_Builder, Sink_Builder, TimePolicy
+
+    C, A, N, WIN, SLIDE = 5, 4, 24, 4000, 1000
+    K = C * A
+    graph = PipeGraph("ffat_comp", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        cs = np.repeat(np.arange(C, dtype=np.int64), A)
+        ads = np.tile(np.arange(A, dtype=np.int64), C)
+        for p in range(N):
+            shipper.set_next_watermark(p * 1000)
+            shipper.push_columns(
+                {"c": cs, "a": ads,
+                 "value": np.full(K, p + 1, dtype=np.int64)},
+                ts=np.full(K, p * 1000 + 5, dtype=np.int64))
+        shipper.set_next_watermark(N * 1000 + WIN)
+
+    ffat = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"], "c": f["c"], "a": f["a"]},
+                lambda x, y: {"value": x["value"] + y["value"],
+                              "c": x["c"], "a": x["a"]})
+            .with_tb_windows(WIN, SLIDE)
+            .with_key_by(("c", "a")).with_key_capacity(K)
+            .with_num_win_per_batch(64).build())
+    res, lock = {}, threading.Lock()
+
+    def sink(t):
+        if t is not None and t["valid"]:
+            with lock:
+                key = (t["c"], t["a"], t["wid"])
+                assert key not in res, f"duplicate window {key}"
+                res[key] = t["value"]
+
+    graph.add_source(Source_Builder(src).with_output_batch_size(K).build()) \
+         .add(ffat).add_sink(Sink_Builder(sink).build())
+    graph.run()
+    for c in range(C):
+        for a in range(A):
+            for w in range(N):
+                panes = [p for p in range(w, w + 4) if p < N]
+                if not panes:
+                    continue
+                expect = sum(p + 1 for p in panes)
+                got = res.get((c, a, w))
+                assert got == expect, ((c, a, w), got, expect)

@@ -132,9 +132,40 @@ def as_key_fn(key):
                 return payload[_name]
             return getattr(payload, _name)
         return field_key
+    names = key_fields_names(key)
+    if names is not None:
+        def fields_key(payload, _names=names):
+            if isinstance(payload, dict):
+                return tuple(payload[f] for f in _names)
+            return tuple(getattr(payload, f) for f in _names)
+        return fields_key
     raise WindFlowError(f"invalid key extractor: {key!r}")
 
 
 def key_field_name(key):
     """The device column name of a key extractor, or None for callables."""
     return key if isinstance(key, str) else None
+
+
+def key_fields_names(key):
+    """The device column names of a COMPOSITE key extractor (a tuple/list
+    of field names, e.g. ``("campaign", "ad")`` — the YSB join key shape),
+    or None. Composite keys extract as tuples on the row path; the device
+    plane routes them as stacked columns with no per-row Python
+    (reference: ``wf/keyby_emitter.hpp:210-228`` hashes any key_t at O(1)
+    C++ cost — here the vectorized column fold is the equivalent).
+    Datetime key fields: ROUTING is consistent across paths, but a
+    stream mixing push() and push_columns() should carry
+    datetime.date/datetime payload values (what numpy 'M8' columns
+    materialize to), not np.datetime64 scalars — the latter hash
+    differently as DICT keys and would register duplicate key slots."""
+    if isinstance(key, (tuple, list)) and key \
+            and all(isinstance(f, str) for f in key):
+        names = tuple(key)
+        if len(set(names)) != len(names):
+            # fail at with_key_by()/build time: the columnar path would
+            # otherwise crash mid-stream in the structured-dtype build
+            raise WindFlowError(
+                f"composite key repeats a field name: {names}")
+        return names
+    return None

@@ -15,7 +15,8 @@ import inspect
 from typing import Any, Callable, List, Optional
 
 from ..basic import (ExecutionMode, OpType, RoutingMode, TimePolicy,
-                     WindFlowError, as_key_fn, key_field_name)
+                     WindFlowError, as_key_fn, key_field_name,
+                     key_fields_names)
 from ..context import RuntimeContext
 from ..message import Batch, Single
 from ..monitoring.stats import StatsRecord
@@ -61,6 +62,7 @@ class BasicOperator:
         # to a callable once here, remembering the field name for the
         # device plane
         self.key_field = key_field_name(key_extractor)
+        self.key_fields = key_fields_names(key_extractor)
         self.key_extractor = as_key_fn(key_extractor)
         self.output_batch_size = output_batch_size
         self.closing_func: Optional[Callable] = None

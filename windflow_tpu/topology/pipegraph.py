@@ -198,7 +198,9 @@ class PipeGraph:
                                    getattr(first, "schema", None),
                                    first.key_extractor,
                                    routing_name, self.execution_mode,
-                                   key_field=first.key_field)
+                                   key_field=first.key_field,
+                                   key_fields=getattr(first, "key_fields",
+                                                      None))
         if p_tpu and c_tpu:  # device -> device
             from ..tpu.emitters_tpu import (TPUBroadcastEmitter,
                                             TPUForwardEmitter,

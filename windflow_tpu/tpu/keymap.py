@@ -73,6 +73,21 @@ class KeySlotMap:
             slot_map = np.fromiter((self.slot(int(k)) for k in uniq),
                                    dtype=np.int64, count=len(uniq))
             return slot_map[inverse]
+        if keys_arr.dtype.kind == "V" and keys_arr.dtype.names:
+            # structured (composite-key) columns: O(n log n) C sort +
+            # one Python slot() per DISTINCT key. Registered as plain
+            # tuples (np.void rows are unhashable and must equal the
+            # tuples the per-row path extracts for the same key). A
+            # field numpy cannot sort (object dtype) falls to per-row.
+            try:
+                uniq, inverse = np.unique(keys_arr[:n], return_inverse=True)
+            except TypeError:
+                return np.fromiter(
+                    (self.slot(k) for k in keys_arr[:n].tolist()),
+                    dtype=np.int64, count=n)
+            slot_map = np.fromiter((self.slot(u.item()) for u in uniq),
+                                   dtype=np.int64, count=len(uniq))
+            return slot_map[inverse]
         return np.fromiter((self.slot(k) for k in keys),
                            dtype=np.int64, count=n)
 

@@ -144,6 +144,21 @@ class TPUReplicaBase(BasicReplica):
             slots[:n] = inv
             slot_of_key = {int(k): i for i, k in enumerate(uniq)}
             return slots, slot_of_key
+        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind == "V" \
+                and keys_arr.dtype.names:
+            # structured composite keys: one unique per batch, slot map
+            # keyed by plain tuples (np.void rows are unhashable and the
+            # per-row path extracts tuples for the same key); a field
+            # numpy cannot sort (object dtype) falls to the row loop
+            try:
+                uniq, inv = np.unique(keys_arr[:n], return_inverse=True)
+            except TypeError:
+                keys = keys_arr[:n].tolist()
+            else:
+                slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
+                slots[:n] = inv
+                slot_of_key = {k.item(): i for i, k in enumerate(uniq)}
+                return slots, slot_of_key
         slot_of_key: Dict[Any, int] = {}
         slots = np.zeros(batch.capacity, dtype=np.int32)
         for i, k in enumerate(keys):
