@@ -35,7 +35,8 @@ WIN_US, SLIDE_US = 100_000, 25_000
 TS_STEP = 50
 
 
-def main(par: int = 2, n_batches: int = 48) -> None:
+def main(par: int = 2, n_batches: int = 48,
+         columnar: bool = False) -> None:
     fired = [0]
     lock = threading.Lock()
 
@@ -58,6 +59,14 @@ def main(par: int = 2, n_batches: int = 48) -> None:
             with lock:
                 fired[0] += 1
 
+    def col_sink(cols, ts):
+        # the with_columns exit: one call per fired-window batch, no
+        # per-row boxing — count valid windows vectorized
+        if cols is not None:
+            n = int(np.sum(cols["valid"]))
+            with lock:
+                fired[0] += n
+
     graph = PipeGraph("scaling", ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME)
     srcs = graph.add_source(
         Source_Builder(make_src(7)).with_output_batch_size(BATCH).build())
@@ -67,17 +76,21 @@ def main(par: int = 2, n_batches: int = 48) -> None:
             .with_tb_windows(WIN_US, SLIDE_US)
             .with_key_by("key").with_key_capacity(N_KEYS // par + 8)
             .with_parallelism(par).build())
-    srcs.add(ffat).add_sink(Sink_Builder(sink).build())
+    sink_b = (Sink_Builder(col_sink).with_columns() if columnar
+              else Sink_Builder(sink))
+    srcs.add(ffat).add_sink(sink_b.build())
 
     t0 = time.perf_counter()
     graph.run()
     dt = time.perf_counter() - t0
     n = n_batches * BATCH
-    print(f"scaling[par={par}]: {n} tuples in {dt:.2f}s "
+    mode = "columnar-sink" if columnar else "row-sink"
+    print(f"scaling[par={par},{mode}]: {n} tuples in {dt:.2f}s "
           f"({n / dt:,.0f} t/s), {fired[0]} windows "
           f"({fired[0] / dt:,.0f} win/s)")
 
 
 if __name__ == "__main__":
     main(int(sys.argv[1]) if len(sys.argv) > 1 else 2,
-         int(sys.argv[2]) if len(sys.argv) > 2 else 48)
+         int(sys.argv[2]) if len(sys.argv) > 2 else 48,
+         len(sys.argv) > 3 and sys.argv[3] == "columnar")

@@ -139,10 +139,18 @@ def main(n_events: int = 60_000) -> None:
                .with_num_win_per_batch(32)
                .with_key_capacity(N_CAMPAIGNS).build())
 
-        def sink(r):
-            if r is not None and r["valid"]:
-                results[(r["campaign"], r["wid"])] = r["count"]
-                latencies.append(now_rel() - r["last_ing"])
+        def sink(cols, ts):
+            # with_columns exit: whole fired-window batches, no per-row
+            # boxing (the round-5 columnar sink edge)
+            if cols is None:
+                return
+            now = now_rel()
+            v = cols["valid"].astype(bool)
+            for c, w, n in zip(cols["campaign"][v].tolist(),
+                               cols["wid"][v].tolist(),
+                               cols["count"][v].tolist()):
+                results[(c, w)] = n
+            latencies.extend((now - cols["last_ing"][v]).tolist())
     else:
         from windflow_tpu import Ffat_Windows_Builder
         # lift to (count, last_ingest): the CPU FlatFAT combines tuples
@@ -156,8 +164,10 @@ def main(n_events: int = 60_000) -> None:
                 results[(r.key, r.wid)] = r.value[0]
                 latencies.append(now_rel() - r.value[1])
 
+    sink_b = (Sink_Builder(sink).with_columns() if USE_TPU
+              else Sink_Builder(sink))
     graph.add_source(src).add(views).add(project).add(win).add_sink(
-        Sink_Builder(sink).build())
+        sink_b.build())
 
     t0 = time.perf_counter()
     graph.run()

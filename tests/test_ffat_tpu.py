@@ -621,3 +621,64 @@ def test_ffat_tpu_composite_key_columnar_pipeline():
                 expect = sum(p + 1 for p in panes)
                 got = res.get((c, a, w))
                 assert got == expect, ((c, a, w), got, expect)
+
+
+@pytest.mark.parametrize("win_par", [1, 2])
+def test_ffat_tpu_composite_key_device_reshard(win_par):
+    """Composite keys past the FIRST staging hop: an UNKEYED device map
+    feeds a composite-keyed windows op, so the key must be built from
+    the device columns at the keyed re-shard (par>1) or by the replica
+    itself (par=1) — no host key metadata exists on that edge."""
+    import threading
+    import numpy as np
+    from windflow_tpu import Source_Builder, Sink_Builder, TimePolicy
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    C, A, N = 4, 3, 20
+    K = C * A
+    graph = PipeGraph(f"ffat_comp_reshard{win_par}", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+
+    def src(shipper, ctx):
+        cs = np.repeat(np.arange(C, dtype=np.int64), A)
+        ads = np.tile(np.arange(A, dtype=np.int64), C)
+        for p in range(N):
+            shipper.set_next_watermark(p * 1000)
+            shipper.push_columns(
+                {"c": cs, "a": ads,
+                 "value": np.full(K, p + 1, dtype=np.int64)},
+                ts=np.full(K, p * 1000 + 5, dtype=np.int64))
+        shipper.set_next_watermark(N * 1000 + 4000)
+
+    premap = Map_TPU_Builder(
+        lambda f: {"c": f["c"], "a": f["a"], "value": f["value"] * 2}
+    ).build()
+    ffat = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"], "c": f["c"], "a": f["a"]},
+                lambda x, y: {"value": x["value"] + y["value"],
+                              "c": x["c"], "a": x["a"]})
+            .with_tb_windows(4000, 1000)
+            .with_key_by(("c", "a")).with_key_capacity(K)
+            .with_parallelism(win_par).build())
+    res, lock = {}, threading.Lock()
+
+    def sink(t):
+        if t is not None and t["valid"]:
+            with lock:
+                key = (t["c"], t["a"], t["wid"])
+                assert key not in res, f"duplicate window {key}"
+                res[key] = t["value"]
+
+    graph.add_source(Source_Builder(src).with_output_batch_size(K).build()) \
+         .add(premap).add(ffat) \
+         .add_sink(Sink_Builder(sink).build())
+    graph.run()
+    for c in range(C):
+        for a in range(A):
+            for w in range(N):
+                panes = [p for p in range(w, w + 4) if p < N]
+                if not panes:
+                    continue
+                expect = 2 * sum(p + 1 for p in panes)
+                got = res.get((c, a, w))
+                assert got == expect, ((c, a, w), got, expect)

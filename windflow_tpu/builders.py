@@ -148,9 +148,25 @@ class Reduce_Builder(_RoutableBuilder):
 class Sink_Builder(_RoutableBuilder):
     _default_name = "sink"
 
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._columns = False
+
+    def with_columns(self) -> "Sink_Builder":
+        """Columnar consumer (the exit-side dual of ``push_columns``):
+        the functor becomes ``sink(cols, ts)`` — ``cols`` a dict of host
+        numpy arrays, ``ts`` the int64 timestamp array; riched variants
+        add the context; EOS delivers ``sink(None, None)``. Requires a
+        device-plane producer: the exit then ships whole column batches
+        with NO per-row boxing (reference exit semantics,
+        ``wf/batch_gpu_t.hpp:154-179``)."""
+        self._columns = True
+        return self
+
     def build(self) -> Sink:
         return self._finish(Sink(self._func, self._name, self._parallelism,
-                                 self._routing, self._key_extractor))
+                                 self._routing, self._key_extractor,
+                                 accepts_columns=self._columns))
 
 
 # ---------------------------------------------------------------------------

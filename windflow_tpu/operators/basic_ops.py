@@ -175,13 +175,22 @@ class Sink(BasicOperator):
 
     def __init__(self, func: Callable, name: str = "sink", parallelism: int = 1,
                  input_routing: RoutingMode = RoutingMode.FORWARD,
-                 key_extractor: Optional[Callable] = None) -> None:
+                 key_extractor: Optional[Callable] = None,
+                 accepts_columns: bool = False) -> None:
         super().__init__(name, parallelism, input_routing, key_extractor, 0)
         self.func = func
-        self._riched = arity(func) >= 2
+        # columnar consumer (the exit-side dual of push_columns): the
+        # functor takes whole COLUMN batches, ``func(cols, ts)`` with
+        # cols a dict of host numpy arrays — device-plane exits then
+        # skip per-row boxing entirely (the reference exit iterates
+        # pinned memory without materializing objects,
+        # ``wf/batch_gpu_t.hpp:154-179``)
+        self.accepts_columns = accepts_columns
+        self._riched = arity(func) >= (3 if accepts_columns else 2)
 
     def build_replicas(self) -> None:
-        self.replicas = [SinkReplica(self, i) for i in range(self.parallelism)]
+        cls = ColumnarSinkReplica if self.accepts_columns else SinkReplica
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
 
 
 class SinkReplica(BasicReplica):
@@ -197,3 +206,43 @@ class SinkReplica(BasicReplica):
             self.op.func(None, self.context)
         else:
             self.op.func(None)
+
+
+class ColumnarSinkReplica(BasicReplica):
+    """Consumes whole device batches as host COLUMN dicts — one functor
+    call per batch, no per-row Python objects on the exit path."""
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        self.stats.start_svc()
+        n = 1
+        if msg.is_punct:
+            self.stats.punct_received += 1
+            self._advance_wm(msg.wm)
+            self.on_punctuation(msg.wm)
+        else:
+            from ..tpu.batch import BatchTPU
+            if not isinstance(msg, BatchTPU):
+                raise WindFlowError(
+                    f"{self.op.name}: with_columns sink received a row "
+                    f"message ({type(msg).__name__}); columnar sinks "
+                    "consume device batches — drop with_columns or move "
+                    "the producer to the device plane")
+            import numpy as np
+            n = msg.size
+            self.stats.inputs_received += n
+            self._advance_wm(msg.wm)
+            cols = {name: np.asarray(col)[:n]
+                    for name, col in msg.fields.items()}
+            ts = msg.ts_host[:n]
+            self.context._set_meta(int(ts[-1]) if n else 0, self.cur_wm)
+            if self.op._riched:
+                self.op.func(cols, ts, self.context)
+            else:
+                self.op.func(cols, ts)
+        self.stats.end_svc(n)
+
+    def flush_on_termination(self) -> None:
+        if self.op._riched:
+            self.op.func(None, None, self.context)
+        else:
+            self.op.func(None, None)

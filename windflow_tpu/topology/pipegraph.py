@@ -208,7 +208,10 @@ class PipeGraph:
             if routing is RoutingMode.KEYBY:
                 return TPUKeyByEmitter(first.key_extractor, n_dests,
                                        self.execution_mode,
-                                       key_field=first.key_field)
+                                       key_field=first.key_field,
+                                       key_fields=getattr(first,
+                                                          "key_fields",
+                                                          None))
             if routing is RoutingMode.BROADCAST:
                 em = TPUBroadcastEmitter(n_dests, 0, self.execution_mode)
             else:
@@ -218,6 +221,22 @@ class PipeGraph:
             # column so a device-computed key never costs a sync D2H
             em.prefetch_field = getattr(first, "key_field", None)
             return em
+        if getattr(first, "accepts_columns", False):
+            # with_columns sink: whole column batches, no row boxing
+            if not p_tpu:
+                raise WindFlowError(
+                    f"{first.name}: with_columns sink needs a device-plane "
+                    "producer (CPU-plane edges deliver rows); drop "
+                    "with_columns or move the producer to the device plane")
+            if routing in (RoutingMode.KEYBY, RoutingMode.BROADCAST):
+                raise WindFlowError(
+                    f"{first.name}: with_columns sink supports forward/"
+                    "rebalancing routing only (whole batches round-robin; "
+                    "keyed distribution would need a device re-shard — "
+                    "put the keyed operator before the sink)")
+            from ..tpu.emitters_tpu import TPUColumnarExitEmitter
+            return TPUColumnarExitEmitter(1 if one_to_one else n_dests,
+                                          self.execution_mode)
         if routing is RoutingMode.KEYBY:
             # key_extractor is normalized to a callable by BasicOperator
             em: BasicEmitter = KeyByEmitter(first.key_extractor, n_dests,

@@ -554,6 +554,26 @@ def _stack_key_fields(cols, key_fields, n: int):
     return kcol
 
 
+def composite_keys_from_device(batch: BatchTPU, key_fields) -> np.ndarray:
+    """Structured key column for a composite-keyed consumer fed WITHOUT
+    host key metadata (an unkeyed device edge upstream): D2H the key
+    field columns and stack them. The fields must be device columns —
+    non-numeric composite members only travel as keyed-staging host
+    metadata."""
+    from ..basic import WindFlowError
+    cols = {}
+    for f in key_fields:
+        col = batch.fields.get(f)
+        if col is None:
+            raise WindFlowError(
+                f"composite key field {f!r} is not a device column of "
+                "this batch; non-numeric composite keys must be keyed at "
+                "the staging edge (with_key_by on the operator fed by "
+                "the CPU plane), which carries them as host metadata")
+        cols[f] = np.asarray(col)
+    return _stack_key_fields(cols, key_fields, batch.size)
+
+
 def _scalar_fnv(lanes) -> int:
     """Scalar twin of the 'S'/'U' branch of ``_column_hashes`` (zero
     lanes skipped): per-row str/bytes keys must route identically to
@@ -676,21 +696,25 @@ class TPUKeyByEmitter(BasicEmitter, _D2HPipeline):
     def __init__(self, key_extractor: Callable, num_dests: int,
                  execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
                  key_field: Optional[str] = None,
-                 depth: Optional[int] = None) -> None:
+                 depth: Optional[int] = None,
+                 key_fields: Optional[Tuple[str, ...]] = None) -> None:
         super().__init__(num_dests, 0, execution_mode)
         self.key_extractor = key_extractor
         self.key_field = key_field
+        self.key_fields = key_fields
         self._pipe_init("WF_KEYBY_PIPELINE_DEPTH", 2, depth)
 
     def _keys_of(self, batch: BatchTPU):
         if batch.host_keys is not None:
             return batch.host_keys
-        if self.key_field is None:
-            raise RuntimeError(
-                "keyed TPU re-shard needs host key metadata or a string "
-                "field-name key extractor (with_key_by('field'))")
-        from .batch import key_column_to_list
-        return key_column_to_list(batch, self.key_field)
+        if self.key_field is not None:
+            from .batch import key_column_to_list
+            return key_column_to_list(batch, self.key_field)
+        if self.key_fields:
+            return composite_keys_from_device(batch, self.key_fields)
+        raise RuntimeError(
+            "keyed TPU re-shard needs host key metadata or a field-name "
+            "key extractor (with_key_by('field') or a tuple of fields)")
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
         if self.num_dests == 1:
@@ -701,8 +725,11 @@ class TPUKeyByEmitter(BasicEmitter, _D2HPipeline):
                 self.stats.outputs_sent += batch.size
             self.ports[0].send(batch)
             return
-        if batch.host_keys is None and self.key_field is not None:
-            _async_copy(batch.fields.get(self.key_field))
+        if batch.host_keys is None and (self.key_field is not None
+                                        or self.key_fields):
+            for f in ((self.key_field,) if self.key_field is not None
+                      else self.key_fields):
+                _async_copy(batch.fields.get(f))
             self._pipe_add(batch)
             return
         self._drain()  # keep stream order ahead of an immediate route
@@ -856,6 +883,38 @@ class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
 
     def eos_ports(self):
         return [p for e in self.inner for p in e.eos_ports()]
+
+
+class TPUColumnarExitEmitter(BasicEmitter, _D2HPipeline):
+    """TPU -> columnar CPU sink: the exit WITHOUT row boxing (the dual
+    of ``push_columns``; the reference exit iterates pinned memory
+    without materializing objects, ``wf/batch_gpu_t.hpp:154-179``).
+    Whole device batches flow to the sink replica, which converts each
+    column once (``np.asarray``) and calls the columnar functor once per
+    batch. D2H rides the same async-copy pipeline as the row exit."""
+
+    def __init__(self, num_dests: int,
+                 execution_mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 depth: Optional[int] = None) -> None:
+        super().__init__(num_dests, 0, execution_mode)
+        self._pipe_init("WF_EXIT_PIPELINE_DEPTH", 4, depth)
+        self._rr = 0
+
+    def emit_device_batch(self, batch: BatchTPU) -> None:
+        batch.prefetch_host()
+        self._pipe_add(batch)
+
+    def _pipe_process(self, batch: BatchTPU) -> None:
+        if self.stats is not None:
+            self.stats.device_bytes_d2h += batch.nbytes()
+        self._send_batch(self._rr, batch)
+        self._rr = (self._rr + 1) % self.num_dests
+
+    def flush(self) -> None:
+        # propagate_punctuation/send_eos_all call flush() first, so
+        # draining here keeps batches ordered ahead of every marker
+        self._drain()
+        super().flush()
 
 
 class TPUExitEmitter(BasicEmitter, _D2HPipeline):

@@ -15,6 +15,18 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 
+def structured_unique(keys_arr: np.ndarray, n: int):
+    """``(uniq, inverse)`` for a structured (composite-key) column, or
+    None when a field numpy cannot sort (object dtype) — callers then
+    walk the rows as ``.tolist()`` tuples. The SINGLE definition of the
+    structured dedup used by every slot-mapping path: slot identity must
+    never diverge between them for the same stream."""
+    try:
+        return np.unique(keys_arr[:n], return_inverse=True)
+    except TypeError:
+        return None
+
+
 class KeySlotMap:
     LUT_MAX = 1 << 22  # 16 MiB int32 ceiling for the direct table
 
@@ -77,14 +89,13 @@ class KeySlotMap:
             # structured (composite-key) columns: O(n log n) C sort +
             # one Python slot() per DISTINCT key. Registered as plain
             # tuples (np.void rows are unhashable and must equal the
-            # tuples the per-row path extracts for the same key). A
-            # field numpy cannot sort (object dtype) falls to per-row.
-            try:
-                uniq, inverse = np.unique(keys_arr[:n], return_inverse=True)
-            except TypeError:
+            # tuples the per-row path extracts for the same key).
+            uu = structured_unique(keys_arr, n)
+            if uu is None:  # an object field: per-row over tuples
                 return np.fromiter(
                     (self.slot(k) for k in keys_arr[:n].tolist()),
                     dtype=np.int64, count=n)
+            uniq, inverse = uu
             slot_map = np.fromiter((self.slot(u.item()) for u in uniq),
                                    dtype=np.int64, count=len(uniq))
             return slot_map[inverse]

@@ -122,11 +122,15 @@ class TPUReplicaBase(BasicReplica):
         keys = batch.host_keys
         if keys is None:
             field = self.op.key_field
-            if field is None:
+            if field is not None:
+                keys = key_column_to_list(batch, field)
+            elif getattr(self.op, "key_fields", None):
+                from .emitters_tpu import composite_keys_from_device
+                keys = composite_keys_from_device(batch, self.op.key_fields)
+            else:
                 raise WindFlowError(
                     f"{self.op.name}: keyed TPU operator needs keyed staging "
-                    "(with_key_by on the op) or a string field-name key")
-            keys = key_column_to_list(batch, field)
+                    "(with_key_by on the op) or a field-name key")
         return keys
 
     def batch_slots_np(self, batch: BatchTPU):
@@ -147,14 +151,14 @@ class TPUReplicaBase(BasicReplica):
         if n and keys_arr.ndim == 1 and keys_arr.dtype.kind == "V" \
                 and keys_arr.dtype.names:
             # structured composite keys: one unique per batch, slot map
-            # keyed by plain tuples (np.void rows are unhashable and the
-            # per-row path extracts tuples for the same key); a field
-            # numpy cannot sort (object dtype) falls to the row loop
-            try:
-                uniq, inv = np.unique(keys_arr[:n], return_inverse=True)
-            except TypeError:
+            # keyed by plain tuples (shared dedup: keymap.py
+            # structured_unique; None = object field, fall to row loop)
+            from .keymap import structured_unique
+            uu = structured_unique(keys_arr, n)
+            if uu is None:
                 keys = keys_arr[:n].tolist()
             else:
+                uniq, inv = uu
                 slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
                 slots[:n] = inv
                 slot_of_key = {k.item(): i for i, k in enumerate(uniq)}
