@@ -172,3 +172,60 @@ def test_ingest_disabled_or_missing(monkeypatch, tmp_path):
     _write_artifact(bench, tmp_path, monkeypatch)
     monkeypatch.setenv("WF_BENCH_INGEST_MAX_AGE_H", "0")
     assert bench._try_ingest() is False
+
+
+def test_probe_grace_late_claim_wins(monkeypatch):
+    """Budget exhausted with a probe still dialing: the bounded grace
+    must keep polling — a slow healthy handshake completing late is
+    still a claim (and measuring under a live probe is the r4 capture
+    hazard the grace exists to avoid)."""
+    polls = []
+
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            polls.append(1)
+            return 0 if len(polls) > 30 else None
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "10")
+    monkeypatch.setenv("WF_BENCH_PROBE_GRACE", "1000")
+    t = [0.0]
+
+    def mono():
+        t[0] += 1.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is True
+
+
+def test_probe_grace_expiry_gives_up_without_kill(monkeypatch):
+    killed = []
+
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return None  # never finishes
+
+        def kill(self):  # pragma: no cover - must never run
+            killed.append(1)
+
+        terminate = kill
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "5")
+    monkeypatch.setenv("WF_BENCH_PROBE_GRACE", "50")
+    t = [0.0]
+
+    def mono():
+        t[0] += 1.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is False
+    assert not killed, "grace must abandon, never kill"
