@@ -1,12 +1,14 @@
 """Randomized keyby-staging soak: random key TYPES (dense int, sparse
-int, str, bytes), fan-outs, batch sizes, and MIXED push()/push_columns()
-staging through a STATEFUL keyed Map_TPU (running per-key counter written
-into the v field). A
-key whose tuples split across replicas gets two independent counters,
-so its observed max counter under-counts — exactly the routing
-consistency the round-4 FNV/scalar key routing must guarantee. The
-numeric ``kid`` label rides the schema; the routing key ``k`` is the
-non-numeric host-metadata extractor under test."""
+int, str, bytes, and round-5 COMPOSITE field tuples — int/int, int/str,
+datetime/int), fan-outs, batch sizes, and MIXED push()/push_columns()
+staging through a STATEFUL keyed Map_TPU (running per-key counter
+written into the v field). A key whose tuples split across replicas
+gets two independent counters, so its observed max counter
+under-counts — exactly the routing consistency the FNV/scalar key
+routing twins must guarantee. The numeric ``kid`` label rides the
+schema; the routing key (single ``k`` or composite ``(ka, kb)``) is the
+host-metadata extractor under test."""
+import datetime as dt
 import os
 import random
 import sys
@@ -31,7 +33,9 @@ rng = random.Random(os.environ.get("SOAK_SEED", "2"))
 while time.monotonic() < t_end:
     runs += 1
     n_keys = rng.choice([1, 3, 8, 40])
-    kind = rng.choice(["dense", "sparse", "str", "bytes"])
+    kind = rng.choice(["dense", "sparse", "str", "bytes",
+                       "comp_int", "comp_mixed", "comp_dt"])
+    comp_dtypes = None  # composite kinds: explicit columnar dtypes
     if kind == "dense":
         keys = list(range(n_keys))
     elif kind == "sparse":
@@ -39,8 +43,20 @@ while time.monotonic() < t_end:
                 for k in range(n_keys)]
     elif kind == "str":
         keys = [f"sym-{k:05d}" for k in range(n_keys)]
-    else:
+    elif kind == "bytes":
         keys = [f"b{k:04d}".encode() for k in range(n_keys)]
+    elif kind == "comp_int":
+        # round-5 composite field-tuple keys: (campaign, ad)-shaped,
+        # negatives included
+        keys = [(k % 5 - 2, k * 31 - 100) for k in range(n_keys)]
+        comp_dtypes = (np.int64, np.int64)
+    elif kind == "comp_mixed":
+        keys = [(k * 7 - 3, f"ad{k % 9}") for k in range(n_keys)]
+        comp_dtypes = (np.int64, None)  # str field: natural np dtype
+    else:  # comp_dt: (day, int) — rows carry datetime.date, columns M8[D]
+        keys = [(dt.date(2021, 1, 1) + dt.timedelta(days=k % 11), k)
+                for k in range(n_keys)]
+        comp_dtypes = ("M8[D]", np.int64)
     op_par = rng.choice([1, 2, 3])
     obs = rng.choice([16, 64, 256])
     n_rows = rng.choice([400, 1500])
@@ -54,14 +70,28 @@ while time.monotonic() < t_end:
     def src(shipper, ctx):
         idx = make_rows()
         half = n_rows // 2 if mix else n_rows
-        for j in idx[:half]:
-            shipper.push({"k": keys[j], "kid": j, "v": 1.0})
-        if half < n_rows:
-            kcol = np.array([keys[j] for j in idx[half:]])
-            shipper.push_columns(
-                {"k": kcol,
-                 "kid": np.array(idx[half:], np.int64),
-                 "v": np.ones(n_rows - half, np.float32)})
+        if comp_dtypes is None:
+            for j in idx[:half]:
+                shipper.push({"k": keys[j], "kid": j, "v": 1.0})
+            if half < n_rows:
+                kcol = np.array([keys[j] for j in idx[half:]])
+                shipper.push_columns(
+                    {"k": kcol,
+                     "kid": np.array(idx[half:], np.int64),
+                     "v": np.ones(n_rows - half, np.float32)})
+        else:
+            for j in idx[:half]:
+                a, b = keys[j]
+                shipper.push({"ka": a, "kb": b, "kid": j, "v": 1.0})
+            if half < n_rows:
+                tail = idx[half:]
+                shipper.push_columns(
+                    {"ka": np.array([keys[j][0] for j in tail],
+                                    dtype=comp_dtypes[0]),
+                     "kb": np.array([keys[j][1] for j in tail],
+                                    dtype=comp_dtypes[1]),
+                     "kid": np.array(tail, np.int64),
+                     "v": np.ones(n_rows - half, np.float32)})
 
     lock = threading.Lock()
     max_n = {}
@@ -84,7 +114,7 @@ while time.monotonic() < t_end:
                 lambda row, st: ({**row, "v": st["n"] + 1.0},
                                  {"n": st["n"] + 1}))
              .with_state({"n": jnp.int32(0)})
-             .with_key_by("k")
+             .with_key_by("k" if comp_dtypes is None else ("ka", "kb"))
              .with_schema(TupleSchema({"kid": np.int64, "v": np.float32}))
              .with_parallelism(op_par).build())
         g.add_source(Source_Builder(src).with_output_batch_size(obs)

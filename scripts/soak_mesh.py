@@ -35,6 +35,10 @@ while time.monotonic() < t_end:
     wm_every = rng.choice([8, 16])
     mesh_shape = rng.choice([None, (8, 1), (4, 2), (2, 4)])
     fire_rounds = rng.choice([2, 4])
+    # no LATE data in this stream, so the two lateness policies must
+    # produce IDENTICAL results — divergence = the ref_fired gate
+    # misfiring (e.g. on idle fast-forwarded keys)
+    late_policy = rng.choice(["keep_open", "ref_fired"])
     # stream: phase 1, optional idle gap (watermark-only advance),
     # phase 2 resume — all timestamps monotone
     p1 = rng.choice([40, 80])
@@ -79,7 +83,8 @@ while time.monotonic() < t_end:
 
     cfg = dict(n_keys=n_keys, sparse=sparse, win=win_us, slide=slide_us,
                obs=obs, wm_every=wm_every, shape=mesh_shape,
-               fr=fire_rounds, p1=p1, gap=gap, p2=p2, ts_step=ts_step)
+               fr=fire_rounds, p1=p1, gap=gap, p2=p2, ts_step=ts_step,
+               lp=late_policy)
     try:
         g = PipeGraph(f"msoak{runs}", ExecutionMode.DEFAULT,
                       TimePolicy.EVENT_TIME)
@@ -88,7 +93,8 @@ while time.monotonic() < t_end:
                 lambda a, b: {"value": a["value"] + b["value"]})
               .with_key_by("key").with_tb_windows(win_us, slide_us)
               .with_key_capacity(n_keys)
-              .with_mesh(mesh_shape=mesh_shape, fire_rounds=fire_rounds)
+              .with_mesh(mesh_shape=mesh_shape, fire_rounds=fire_rounds,
+                         late_policy=late_policy)
               .build())
         g.add_source(Source_Builder(src).with_output_batch_size(obs)
                      .build()).add(op).add_sink(Sink_Builder(sink).build())
