@@ -120,7 +120,155 @@ def _cpu_env() -> dict:
     return env
 
 
+def _lock_path() -> str:
+    return os.environ.get("WF_RELAY_LOCK", "/tmp/wf_relay_client.lock")
+
+
+def _lock_max_age() -> float:
+    return float(os.environ.get("WF_BENCH_LOCK_MAX_AGE", "10800"))
+
+
+def _lock_age():
+    try:
+        return time.time() - os.path.getmtime(_lock_path())
+    except OSError:
+        return None
+
+
+def _lock_owner() -> str:
+    """First whitespace-delimited token of the lock content (EXACT
+    ownership id — substring matching would let pid 123 claim a lock
+    held by pid 1234)."""
+    try:
+        with open(_lock_path()) as f:
+            head = f.read().split()
+        return head[0] if head else ""
+    except OSError:
+        return ""
+
+
+def _my_id() -> str:
+    return f"bench:{os.getpid()}"
+
+
+def _foreign_lock_fresh() -> bool:
+    """A fresh lock NOT owned by this process (the watcher's, or another
+    bench's) means the single-client relay line is busy."""
+    age = _lock_age()
+    if age is None or age >= _lock_max_age():
+        return False
+    return _lock_owner() != _my_id()
+
+
+def _hold_line() -> bool:
+    """Mark the line busy for OUR dial/measurement (mutual exclusion is
+    two-directional: the watcher also checks for fresh foreign locks).
+    Atomic O_EXCL create closes the check-then-write race: losing the
+    race to another client returns False (caller re-waits). A stale or
+    self-owned leftover is replaced."""
+    path = _lock_path()
+    for _ in range(2):
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                pre = os.path.getmtime(path)
+            except OSError:
+                continue  # vanished under us; retry the create
+            if time.time() - pre < _lock_max_age() \
+                    and _lock_owner() != _my_id():
+                return False  # lost the race to a live client
+            try:
+                # stale or ours: replace — but only if UNCHANGED since
+                # the check (another client may have just recreated it)
+                if os.path.getmtime(path) == pre:
+                    os.remove(path)
+            except OSError:
+                pass
+            continue
+        except OSError as e:
+            # an unusable lock dir silently disabling mutual exclusion
+            # would be invisible in the logs — say so loudly, then
+            # proceed (measuring beats not measuring)
+            print(f"bench: relay lock unusable ({e}); dialing WITHOUT "
+                  "mutual exclusion", file=sys.stderr)
+            return True
+        with os.fdopen(fd, "w") as f:
+            f.write(_my_id() + "\n")
+        return True
+    return False
+
+
+def _refresh_line() -> None:
+    """mtime refresh of a lock we already own (never remove/recreate —
+    that would open an ownership gap another client could slip into)."""
+    if _lock_owner() == _my_id():
+        try:
+            os.utime(_lock_path())
+        except OSError:
+            pass
+
+
+def _stamp_line_for_probe(pid: int) -> None:
+    """Re-own the lock on behalf of a still-dialing abandoned probe: the
+    line IS busy until that process dies, and nothing in THIS process
+    may release it (staleness bounds the cleanup)."""
+    try:
+        with open(_lock_path(), "w") as f:
+            f.write(f"bench-probe:{pid}\n")
+    except OSError:
+        pass
+
+
+def _release_line() -> None:
+    """Remove the lock ONLY if this process owns it — never delete a
+    foreign client's live lock."""
+    try:
+        if _lock_owner() == _my_id():
+            os.remove(_lock_path())
+    except OSError:
+        pass
+
+
+def _await_line_free(t_end: float) -> str:
+    """Wait (bounded by ``t_end``) while a fresh foreign lock holds the
+    relay line. Returns "free" (dial now), "artifact" (a fresh session
+    artifact appeared — ingest instead), or "timeout"."""
+    if not _foreign_lock_fresh():
+        return "free"
+    try:
+        art0 = os.path.getmtime(ARTIFACT)
+    except OSError:
+        art0 = 0.0
+    age = _lock_age() or 0.0  # lock can vanish between checks (TOCTOU)
+    print(f"bench: another relay client holds the line (lock age "
+          f"{age:.0f}s); waiting instead of dialing", file=sys.stderr)
+    while time.monotonic() < t_end:
+        time.sleep(5.0)
+        try:
+            if os.path.getmtime(ARTIFACT) > art0:
+                print("bench: a fresh session artifact appeared while "
+                      "waiting; ingesting instead of dialing",
+                      file=sys.stderr)
+                return "artifact"
+        except OSError:
+            pass
+        if not _foreign_lock_fresh():
+            print("bench: relay line released; dialing with the "
+                  "remaining budget", file=sys.stderr)
+            return "free"
+    return "timeout"
+
+
 def _probe_backend() -> bool:
+    """True iff the TPU backend claimed. Cooperative single-client
+    discipline: the repo watcher (scripts/tpu_watch.sh) holds a lock
+    file while ITS probe/claim/session is in flight; dialing alongside
+    it would make two clients on a single-client relay (they kill each
+    other's 25-minute handshakes — the round-4/5 failure mode). The
+    foreign-lock check re-runs before EVERY attempt (the watcher can
+    grab the line during a backoff sleep), and on a successful claim
+    the lock stays HELD for the measurement (main() releases it)."""
     budget = float(os.environ.get("WF_BENCH_PROBE_BUDGET", "1200"))
     backoff = float(os.environ.get("WF_BENCH_PROBE_BACKOFF", "20"))
     t_end = time.monotonic() + budget
@@ -131,9 +279,21 @@ def _probe_backend() -> bool:
             time.sleep(min(backoff, max(0.0, t_end - time.monotonic())))
             if time.monotonic() >= t_end:
                 break
+        state = _await_line_free(t_end)
+        if state == "artifact":
+            return False  # main() ingests it
+        if state == "timeout":
+            print("bench: probe budget spent waiting on the other relay "
+                  "client; not dialing. The fallback will run while that "
+                  "client's probe/session is still live — recorded as "
+                  "contended", file=sys.stderr)
+            os.environ["WF_BENCH_CONTENDED"] = "1"  # survives the re-exec
+            return False
         remaining = t_end - time.monotonic()
         print(f"bench: probing TPU backend (attempt {attempt}, "
               f"{remaining:.0f}s of budget left)", file=sys.stderr)
+        if not _hold_line():
+            continue  # lost the lock race; re-wait on the next attempt
         p = subprocess.Popen(
             [sys.executable, "-c",
              "import jax; jax.devices(); print('ok')"],
@@ -143,7 +303,9 @@ def _probe_backend() -> bool:
             rc = p.poll()
             if rc is not None:
                 if rc == 0:
+                    _refresh_line()  # held through the measurement
                     return True
+                _release_line()
                 print(f"bench: probe failed rc={rc}", file=sys.stderr)
                 break  # backend errored (e.g. UNAVAILABLE) -> retry
             time.sleep(1.0)
@@ -167,15 +329,23 @@ def _probe_backend() -> bool:
                 rc = p.poll()
                 if rc is not None:
                     if rc == 0:
+                        _refresh_line()  # held through measurement
                         return True
+                    _release_line()
                     print(f"bench: late probe exit rc={rc}",
                           file=sys.stderr)
                     break
                 time.sleep(2.0)
             else:
+                # the abandoned probe still owns the line: re-stamp the
+                # lock in the PROBE's name so no later step of this
+                # process (fallback re-exec included) releases it —
+                # staleness bounds the cleanup — and record contention
                 print("bench: grace expired; probe still alive — "
                       "fallback will run contended (noted)",
                       file=sys.stderr)
+                _stamp_line_for_probe(getattr(p, "pid", 0))
+                os.environ["WF_BENCH_CONTENDED"] = "1"
     return False
 
 
@@ -262,6 +432,7 @@ def _try_ingest() -> bool:
 
 
 def _fallback_to_cpu() -> None:
+    _release_line()  # the CPU fallback dials nothing; free the line
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               _cpu_env())
 
@@ -604,12 +775,12 @@ def main() -> None:
               file=sys.stderr)
         _fallback_to_cpu()
 
-    import jax
-
-    platform = jax.devices()[0].platform
-    print(f"bench: platform={platform}", file=sys.stderr)
-
     try:
+        import jax
+
+        platform = jax.devices()[0].platform
+        print(f"bench: platform={platform}", file=sys.stderr)
+
         _measure_and_report(platform, fallback)
     except Exception as e:  # the relay can die MID-RUN (remote_compile
         # refused / UNAVAILABLE); a benchmark that prints no JSON line is
@@ -618,11 +789,19 @@ def main() -> None:
             raise
         print(f"bench: TPU backend failed mid-run ({type(e).__name__}: "
               f"{e})", file=sys.stderr)
+        _release_line()
         if _try_ingest():
             return
         print("bench: no ingestible session artifact; falling back to CPU",
               file=sys.stderr)
         _fallback_to_cpu()
+    finally:
+        # free the relay line no matter how the claim path exits —
+        # SystemExit/KeyboardInterrupt included: a leaked fresh lock
+        # parks the watcher for hours. Ownership-checked (no-op when we
+        # hold nothing; the grace-expiry path re-stamped the lock to
+        # the still-dialing probe, so this cannot release that one).
+        _release_line()
 
 
 def _chunk_stats(chunks) -> dict:
@@ -732,6 +911,11 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
         "stateful_map_tuples_per_sec": round(smap_tps, 1),
         "keyed_reduce_tuples_per_sec": round(kred_tps, 1),
     }
+    if os.environ.get("WF_BENCH_CONTENDED") == "1":
+        # measured while another relay client (watcher probe/session or
+        # our own abandoned probe) was live on this 1-core host — the
+        # capture-forensics marker the unexplained r4 drop lacked
+        result["contended_by_relay_client"] = True
     mesh = _mesh_fields(platform)
     if mesh:
         _log(f"mesh plane {mesh['mesh_n_devices']} dev "

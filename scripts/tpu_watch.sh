@@ -7,20 +7,79 @@
 # deliberately persisted across rounds as bench.py's ingest source, so
 # bare existence proves nothing; and a claim can die mid-session and
 # leave nothing — exiting then would silently end coverage).
+#
+# SINGLE-CLIENT LOCK: the relay serves one client; two dialers kill
+# each other's 25-minute handshakes (the round-4/5 failure mode, found
+# as a stale duplicate watcher). While a probe/claim/session is in
+# flight this script holds $LOCK (content "watch:<pid>"); bench.py's
+# probe sees it and WAITS (then ingests the session artifact or dials
+# once the line frees). The check is two-directional: a fresh FOREIGN
+# lock (e.g. the driver's bench dialing, content "bench:<pid>") makes
+# this script wait too, and it never deletes a lock it does not own.
+# Both sides share the staleness bound WF_BENCH_LOCK_MAX_AGE (seconds,
+# default 10800).
 cd "$(dirname "$0")/.."
 OUT="${WF_WATCH_LOG:-/tmp/tpu_watch.log}"
 ART="results/bench_tpu_latest.json"
+LOCK="${WF_RELAY_LOCK:-/tmp/wf_relay_client.lock}"
+# ceil to minutes: the shell must never declare a lock stale
+# EARLIER than the python side (a truncated bound would let the
+# watcher seize a lock a waiting bench still honors)
+MAXAGE_MIN=$(( (${WF_BENCH_LOCK_MAX_AGE:-10800} + 59) / 60 ))
 STAMP="$(mktemp /tmp/tpu_watch_start.XXXXXX)"
-echo "=== tpu_watch start $(date -u +%F' '%T) ===" >> "$OUT"
+
+own_lock() { [ -f "$LOCK" ] && grep -q "^watch:$$ " "$LOCK" 2>/dev/null; }
+rm_lock()  { own_lock && rm -f "$LOCK"; }
+foreign_lock_fresh() {
+    [ -f "$LOCK" ] && ! own_lock \
+        && [ -z "$(find "$LOCK" -mmin +"$MAXAGE_MIN" 2>/dev/null)" ]
+}
+art_fresh() {
+    [ -s "$ART" ] && [ "$ART" -nt "$STAMP" ] \
+        && grep -q '"platform": "tpu"' "$ART"
+}
+
+trap 'rm_lock; rm -f "$STAMP"' EXIT
+echo "=== tpu_watch start $(date -u +%F' '%T) (lock $LOCK) ===" >> "$OUT"
 while true; do
+    # another client (e.g. the driver's bench) may have claimed,
+    # measured and persisted the artifact while we waited — done
+    if art_fresh; then
+        echo "fresh artifact present; watch complete" >> "$OUT"
+        break
+    fi
+    # respect a fresh FOREIGN lock: mutual exclusion in both directions
+    if foreign_lock_fresh; then
+        echo "foreign relay client holds the line $(date -u +%T);" \
+             "waiting 60s" >> "$OUT"
+        sleep 60
+        continue
+    fi
     echo "probe $(date -u +%T)" >> "$OUT"
+    # atomic acquisition (noclobber): losing the race to another client
+    # loops back to the foreign-lock wait instead of clobbering it.
+    # Remove ONLY self-owned or provably-stale leftovers first — an
+    # unconditional rm here could delete a lock a client atomically
+    # created since the freshness check above
+    rm_lock
+    if [ -f "$LOCK" ] && [ -n "$(find "$LOCK" -mmin +"$MAXAGE_MIN" 2>/dev/null)" ]; then
+        rm -f "$LOCK"
+    fi
+    if ! ( set -o noclobber; \
+           echo "watch:$$ $(date -u)" > "$LOCK" ) 2>/dev/null; then
+        echo "lost the lock race $(date -u +%T); waiting" >> "$OUT"
+        sleep 60
+        continue
+    fi
     if python -c "import jax; jax.devices(); print('claimed')" \
         >> "$OUT" 2>&1; then
         echo "claim OK $(date -u +%T); running session" >> "$OUT"
-        bash scripts/tpu_session.sh >> "$OUT" 2>&1
+        touch "$LOCK"  # refresh mtime; content stays watch:$$
+        WF_SESSION_TOUCH_LOCK="$LOCK" bash scripts/tpu_session.sh \
+            >> "$OUT" 2>&1
+        rm_lock
         echo "session done $(date -u +%T)" >> "$OUT"
-        if [ -s "$ART" ] && [ "$ART" -nt "$STAMP" ] \
-                && grep -q '"platform": "tpu"' "$ART"; then
+        if art_fresh; then
             echo "fresh artifact present; watch complete" >> "$OUT"
             break
         fi
@@ -28,8 +87,8 @@ while true; do
              "mid-session?); resuming watch" >> "$OUT"
         sleep 180
     else
+        rm_lock
         echo "probe failed $(date -u +%T); sleeping 180s" >> "$OUT"
         sleep 180
     fi
 done
-rm -f "$STAMP"

@@ -18,6 +18,12 @@ def _load_bench(monkeypatch, fake_popen=None):
     if fake_popen is not None:
         monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # isolate from the REAL watcher's relay lock: a live watcher on this
+    # host would otherwise park every probe test in the wait loop
+    monkeypatch.setenv("WF_RELAY_LOCK", "/nonexistent/wf_test_relay.lock")
+    # the contended marker is set via os.environ (it must survive the
+    # fallback re-exec); scrub it so tests stay order-independent
+    monkeypatch.delenv("WF_BENCH_CONTENDED", raising=False)
     return bench
 
 
@@ -229,3 +235,180 @@ def test_probe_grace_expiry_gives_up_without_kill(monkeypatch):
     monkeypatch.setattr(bench.time, "monotonic", mono)
     assert bench._probe_backend() is False
     assert not killed, "grace must abandon, never kill"
+
+
+def test_probe_lock_waits_and_ingest_signal(monkeypatch, tmp_path):
+    """A fresh relay-client lock (the watcher dialing/claiming) must stop
+    the bench from dialing alongside (two clients kill each other's
+    handshakes); a session artifact appearing while waiting signals the
+    ingest path (return False WITHOUT ever spawning a probe)."""
+    spawned = []
+
+    class P:  # pragma: no cover - must never be constructed
+        def __init__(self, *a, **k):
+            spawned.append(1)
+
+        def poll(self):
+            return 0
+
+    bench = _load_bench(monkeypatch, P)
+    lock = tmp_path / "relay.lock"
+    lock.write_text("watcher")
+    art = tmp_path / "bench_tpu_latest.json"
+    monkeypatch.setattr(bench, "ARTIFACT", str(art))
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "100")
+    t = [0.0]
+
+    def mono():
+        t[0] += 5.0
+        # artifact "appears" mid-wait
+        if t[0] > 30 and not art.exists():
+            art.write_text("{}")
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is False
+    assert not spawned, "bench must not dial while the lock is held"
+
+
+def test_probe_lock_release_then_dial(monkeypatch, tmp_path):
+    """Lock released mid-budget: the bench dials with the remaining
+    budget and a healthy claim wins."""
+    spawned = []
+
+    class P:
+        def __init__(self, *a, **k):
+            spawned.append(1)
+
+        def poll(self):
+            return 0
+
+    bench = _load_bench(monkeypatch, P)
+    lock = tmp_path / "relay.lock"
+    lock.write_text("watcher")
+    monkeypatch.setattr(bench, "ARTIFACT", str(tmp_path / "none.json"))
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "100")
+    t = [0.0]
+
+    def mono():
+        t[0] += 5.0
+        if t[0] > 30 and lock.exists():
+            lock.unlink()  # watcher released the line
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is True
+    assert spawned, "bench must dial once the line is free"
+
+
+def test_probe_stale_lock_dials_immediately(monkeypatch, tmp_path):
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return 0
+
+    bench = _load_bench(monkeypatch, P)
+    lock = tmp_path / "relay.lock"
+    lock.write_text("dead watcher")
+    old = time.time() - 4 * 3600
+    os.utime(lock, (old, old))
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    assert bench._probe_backend() is True
+
+
+def test_probe_recheck_lock_between_attempts(monkeypatch, tmp_path):
+    """The foreign-lock check must re-run before EVERY dial attempt: a
+    watcher that grabs the line during the backoff sleep must not be
+    dialed over (and its lock must not be clobbered)."""
+    lock = tmp_path / "relay.lock"
+    dials = []
+
+    class P:
+        def __init__(self, *a, **k):
+            dials.append(1)
+            # simulate: the watcher grabs the line right after our
+            # first (failing) dial returns
+            if len(dials) == 1:
+                lock.write_text("watch:9999")
+
+        def poll(self):
+            return 1  # fast UNAVAILABLE
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    monkeypatch.setattr(bench, "ARTIFACT", str(tmp_path / "none.json"))
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "200")
+    monkeypatch.setenv("WF_BENCH_PROBE_BACKOFF", "5")
+    t = [0.0]
+
+    def mono():
+        t[0] += 5.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is False
+    assert len(dials) == 1, "second attempt dialed over the watcher's lock"
+    assert lock.read_text().startswith("watch:"), "foreign lock clobbered"
+    assert os.environ.get("WF_BENCH_CONTENDED") == "1"
+
+
+def test_probe_success_holds_lock_for_measurement(monkeypatch, tmp_path):
+    """On a claim the lock must stay HELD (main() releases it after the
+    measurement): a watcher waking mid-measurement must see the line
+    busy, not dial into the live session."""
+    lock = tmp_path / "relay.lock"
+
+    class P:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return 0
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    assert bench._probe_backend() is True
+    assert lock.exists() and "bench:" in lock.read_text()
+    bench._release_line()
+    assert not lock.exists()
+    # ownership check: a foreign lock is never deleted
+    lock.write_text("watch:1234")
+    bench._release_line()
+    assert lock.exists()
+
+
+def test_probe_grace_expiry_restamps_lock_for_probe(monkeypatch, tmp_path):
+    """Grace expired with the abandoned probe still dialing: the lock is
+    re-stamped in the PROBE's name so nothing in this process (fallback
+    re-exec included) releases the line while that probe lives."""
+    lock = tmp_path / "relay.lock"
+
+    class P:
+        pid = 4242
+
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return None
+
+    bench = _load_bench(monkeypatch, P)
+    monkeypatch.setenv("WF_RELAY_LOCK", str(lock))
+    monkeypatch.setenv("WF_BENCH_PROBE_BUDGET", "5")
+    monkeypatch.setenv("WF_BENCH_PROBE_GRACE", "50")
+    t = [0.0]
+
+    def mono():
+        t[0] += 1.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    assert bench._probe_backend() is False
+    assert lock.read_text().startswith("bench-probe:4242")
+    bench._release_line()  # not ours anymore: must NOT delete
+    assert lock.exists()
+    assert os.environ.get("WF_BENCH_CONTENDED") == "1"
