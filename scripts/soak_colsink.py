@@ -1,0 +1,112 @@
+"""Randomized differential soak for the columnar sink edge: the SAME
+randomized device pipeline (columnar source -> optional stateless
+Map_TPU / Filter_TPU -> optional keyed FFAT windows) run twice, once
+with a row sink and once with ``with_columns()``, must deliver exactly
+the same multiset of results — the exit representation is a layout
+choice, never a semantics choice."""
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_S = float(os.environ.get("SOAK_S", "600"))
+
+import numpy as np
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy)
+from windflow_tpu.tpu import (Ffat_Windows_TPU_Builder, Filter_TPU_Builder,
+                              Map_TPU_Builder)
+
+t_end = time.monotonic() + BUDGET_S
+runs = fails = 0
+rng = random.Random(os.environ.get("SOAK_SEED", "3"))
+
+while time.monotonic() < t_end:
+    runs += 1
+    n_keys = rng.choice([1, 4, 9])
+    obs = rng.choice([16, 64, 128])
+    panes = rng.choice([12, 30])
+    use_map = rng.random() < 0.7
+    use_filter = rng.random() < 0.4
+    use_win = rng.random() < 0.6
+    if not (use_map or use_filter or use_win):
+        use_map = True  # the sink needs a device-plane producer (by design)
+    win_us, slide_us = rng.choice([(4000, 1000), (3000, 3000)])
+    seed = rng.randrange(1 << 30)
+
+    def src(shipper, ctx):
+        r2 = np.random.default_rng(seed)
+        for p in range(panes):
+            shipper.set_next_watermark(p * 1000)
+            shipper.push_columns(
+                {"key": np.arange(n_keys, dtype=np.int64),
+                 "value": r2.integers(1, 50, n_keys).astype(np.int64)},
+                ts=np.full(n_keys, p * 1000 + 5, dtype=np.int64))
+        shipper.set_next_watermark(panes * 1000 + win_us)
+
+    def build(columnar):
+        rows = []
+        lock = threading.Lock()
+
+        def row_sink(t):
+            if t is None:
+                return
+            with lock:
+                rows.append(tuple(sorted(t.items())))
+
+        def col_sink(cols, ts):
+            if cols is None:
+                return
+            names = sorted(cols)
+            with lock:
+                for i in range(len(ts)):
+                    rows.append(tuple(
+                        (k, cols[k][i].item()) for k in names))
+
+        g = PipeGraph(f"csoak{runs}_{columnar}", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+        node = g.add_source(
+            Source_Builder(src).with_output_batch_size(obs).build())
+        if use_map:
+            node = node.add(Map_TPU_Builder(
+                lambda c: {"key": c["key"],
+                           "value": c["value"] * 2}).build())
+        if use_filter:
+            node = node.add(Filter_TPU_Builder(
+                lambda c: c["value"] % 3 != 0).build())
+        if use_win:
+            node = node.add(Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"], "key2": f["key"]},
+                lambda a, b: {"value": a["value"] + b["value"],
+                              "key2": a["key2"]})
+                .with_tb_windows(win_us, slide_us)
+                .with_key_by("key").with_key_capacity(n_keys).build())
+        sb = (Sink_Builder(col_sink).with_columns() if columnar
+              else Sink_Builder(row_sink))
+        node.add_sink(sb.build())
+        g.run()
+        return sorted(rows)
+
+    cfg = dict(n_keys=n_keys, obs=obs, panes=panes, use_map=use_map,
+               use_filter=use_filter, use_win=use_win,
+               win=(win_us, slide_us))
+    try:
+        row_res = build(False)
+        col_res = build(True)
+        if row_res != col_res:
+            fails += 1
+            diff_r = [x for x in row_res if x not in col_res][:3]
+            diff_c = [x for x in col_res if x not in row_res][:3]
+            print(f"MISMATCH run={runs} cfg={cfg} "
+                  f"row_only={diff_r} col_only={diff_c}", flush=True)
+    except Exception as e:
+        fails += 1
+        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
+              flush=True)
+
+print(f"colsink soak done: {runs} runs, {fails} failures", flush=True)
+sys.exit(1 if fails else 0)
