@@ -342,3 +342,56 @@ def test_stage_emitter_ships_partial_on_age(monkeypatch):
     for i in range(1000, 1000 + em._SWEEP_EVERY):
         em.emit({"v": i}, ts=i, wm=0)
     assert len(sent) == 3  # swept by the countdown, not by batch fill
+
+
+class _RecPort:
+    def __init__(self):
+        self.msgs = []
+
+    def send(self, m):
+        self.msgs.append(m)
+
+
+def test_columnar_exit_fifo_order_punct_and_idle():
+    """The columnar exit (with_columns sinks) must obey the same FIFO
+    contract as the row exit: batches defer up to depth and deliver in
+    order; punctuation drains queued batches first (watermarks stay
+    monotone at the sink); the idle tick flushes a quiet stream."""
+    from windflow_tpu.tpu.emitters_tpu import TPUColumnarExitEmitter
+
+    em = TPUColumnarExitEmitter(1, depth=2)
+    port = _RecPort()
+    em.set_ports([port])
+    em.emit_device_batch(_batch(0, wm=1))
+    em.emit_device_batch(_batch(10, wm=2))
+    assert port.msgs == []                     # both parked
+    em.emit_device_batch(_batch(20, wm=3))     # pushes the first out
+    assert len(port.msgs) == 1
+    assert int(np.asarray(port.msgs[0].fields["v"])[0]) == 0
+    # punctuation must not overtake queued batches
+    em.propagate_punctuation(7)
+    kinds = [(getattr(m, "is_punct", False),
+              None if getattr(m, "is_punct", False)
+              else int(np.asarray(m.fields["v"])[0])) for m in port.msgs]
+    assert kinds == [(False, 0), (False, 10), (False, 20), (True, None)]
+    assert port.msgs[-1].wm == 7
+    # ids stamp densely in delivery order
+    assert [m.id for m in port.msgs] == [0, 1, 2, 3]
+    # idle tick delivers a parked batch on a quiet stream
+    em.emit_device_batch(_batch(30, wm=8))
+    before = len(port.msgs)
+    assert em.on_idle() is True
+    assert len(port.msgs) == before + 1
+
+
+def test_columnar_exit_round_robins_parallel_sinks():
+    from windflow_tpu.tpu.emitters_tpu import TPUColumnarExitEmitter
+
+    em = TPUColumnarExitEmitter(2, depth=0)
+    p0, p1 = _RecPort(), _RecPort()
+    em.set_ports([p0, p1])
+    for i in range(4):
+        em.emit_device_batch(_batch(i * 10, wm=i))
+    assert len(p0.msgs) == 2 and len(p1.msgs) == 2
+    assert [int(np.asarray(m.fields["v"])[0]) for m in p0.msgs] == [0, 20]
+    assert [int(np.asarray(m.fields["v"])[0]) for m in p1.msgs] == [10, 30]
