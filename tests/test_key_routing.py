@@ -304,7 +304,11 @@ def test_composite_key_datetime_byteorder_invariant():
         assert dn is not None and (dn == db).all(), dt_s
 
 
-def test_composite_key_twins_randomized_fuzz():
+import pytest
+
+
+@pytest.mark.parametrize("fuzz_seed", [7, 41])
+def test_composite_key_twins_randomized_fuzz(fuzz_seed):
     """Randomized differential check over the dtype corners: for random
     field dtypes (ints of every width/signedness, floats of every
     width, bool, fixed-width str/bytes, date/time units) and random
@@ -318,8 +322,8 @@ def test_composite_key_twins_randomized_fuzz():
     from windflow_tpu.tpu.emitters_tpu import (_composite_key_dests,
                                                _vector_key_dests)
 
-    rng = random.Random(7)
-    nprng = np.random.default_rng(7)
+    rng = random.Random(fuzz_seed)
+    nprng = np.random.default_rng(fuzz_seed)
 
     def make_field(n):
         kind = rng.choice(["int", "uint", "float", "bool", "str",
