@@ -412,3 +412,58 @@ def test_probe_grace_expiry_restamps_lock_for_probe(monkeypatch, tmp_path):
     bench._release_line()  # not ours anymore: must NOT delete
     assert lock.exists()
     assert os.environ.get("WF_BENCH_CONTENDED") == "1"
+
+
+def test_ab_mode_pair_math_and_persistence(monkeypatch, tmp_path, capsys):
+    """--ab attribution math: canned subprocess results produce the
+    right per-pair deltas, paired means, verdict, and persisted record
+    (future cross-round perf claims hang off this harness)."""
+    bench = _load_bench(monkeypatch)
+    # worktree exists: no git calls needed
+    wt = tmp_path / f"wf_ab_{'d5ec96d'[:12]}"
+    wt.mkdir()
+    (wt / "bench.py").write_text("# pin stub")
+    monkeypatch.setattr(bench.os.path, "isdir",
+                        lambda p: True if str(p) == str(wt) else
+                        os.path.isdir(p))
+    results = {
+        "head": [{"value": 11.0e6, "tuples_per_sec_16k_batches": 6.0e6},
+                 {"value": 9.0e6, "tuples_per_sec_16k_batches": 6.6e6}],
+        "pin": [{"value": 10.0e6, "tuples_per_sec_16k_batches": 6.0e6},
+                {"value": 10.0e6, "tuples_per_sec_16k_batches": 6.0e6}],
+    }
+    calls = {"head": 0, "pin": 0}
+
+    class R:
+        returncode = 0
+        stderr = ""
+
+        def __init__(self, out):
+            self.stdout = out
+
+    def fake_run(cmd, **kw):
+        if cmd[0] == sys.executable:
+            side = "pin" if "wf_ab_" in cmd[1] else "head"
+            r = results[side][calls[side]]
+            calls[side] += 1
+            return R(json.dumps(r) + "\n")
+        return R("")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    # persist into a temp repo dir
+    monkeypatch.setattr(bench.os.path, "abspath",
+                        lambda p: str(tmp_path / "bench.py"))
+    monkeypatch.setattr(bench, "_git_sha", lambda: "headsha")
+    monkeypatch.setattr(bench, "AB_PIN_SHA", "d5ec96d")
+    monkeypatch.setattr(bench.os.path, "isdir", lambda p: True)
+    bench._ab_mode("d5ec96d")
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert [p["delta_pct"] for p in rec["pairs"]] == [10.0, -10.0]
+    assert rec["mean_delta_pct"] == 0.0
+    assert rec["attribution"] == "noise-or-small"  # signs straddle zero
+    assert rec["mean_delta_16k_pct"] == 5.0
+    assert rec["head_sha"] == "headsha"
+    saved = json.loads(
+        (tmp_path / "results" / "ab_bench.json").read_text())
+    assert saved["pairs"] == rec["pairs"]
