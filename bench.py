@@ -160,12 +160,44 @@ def _foreign_lock_fresh() -> bool:
     return _lock_owner() != _my_id()
 
 
+def _reap_stale_lock(path: str, pre: float) -> None:
+    """Remove a stale/self-owned lock BY IDENTITY: atomically rename it
+    to a private name first, verify the renamed file is still the one
+    judged stale (same mtime), and hand it back if a peer recreated the
+    path in the window. The old remove-if-mtime-unchanged had a TOCTOU
+    hole — between the mtime re-check and os.remove a peer could delete
+    the stale lock and atomically recreate it, and the remove would then
+    delete the PEER's fresh lock. rename moves whatever is at ``path``
+    out of the shared namespace in one atomic step; only a file we
+    verified is the stale one gets unlinked."""
+    tmp = f"{path}.reap.{os.getpid()}"
+    try:
+        os.rename(path, tmp)
+    except OSError:
+        return  # vanished under us (peer reaped it first): nothing to do
+    try:
+        if os.path.getmtime(tmp) != pre:
+            # not the file we judged stale — a peer recreated the lock
+            # in the window and our rename captured it. Give it back:
+            # link() is atomic and refuses to clobber, so an even newer
+            # lock that appeared meanwhile wins and the captured one is
+            # simply dropped (its owner re-checks ownership by content).
+            try:
+                os.link(tmp, path)
+            except OSError:
+                pass
+        os.unlink(tmp)
+    except OSError:
+        pass
+
+
 def _hold_line() -> bool:
     """Mark the line busy for OUR dial/measurement (mutual exclusion is
     two-directional: the watcher also checks for fresh foreign locks).
     Atomic O_EXCL create closes the check-then-write race: losing the
     race to another client returns False (caller re-waits). A stale or
-    self-owned leftover is replaced."""
+    self-owned leftover is reaped by identity (rename-then-verify, see
+    _reap_stale_lock)."""
     path = _lock_path()
     for _ in range(2):
         try:
@@ -178,13 +210,7 @@ def _hold_line() -> bool:
             if time.time() - pre < _lock_max_age() \
                     and _lock_owner() != _my_id():
                 return False  # lost the race to a live client
-            try:
-                # stale or ours: replace — but only if UNCHANGED since
-                # the check (another client may have just recreated it)
-                if os.path.getmtime(path) == pre:
-                    os.remove(path)
-            except OSError:
-                pass
+            _reap_stale_lock(path, pre)
             continue
         except OSError as e:
             # an unusable lock dir silently disabling mutual exclusion
@@ -542,6 +568,7 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
 
     for b in batches[:WARMUP]:
         rep.handle_msg(0, b)
+    rep.dispatch.drain()  # commit deferred warmup batches (WF_DISPATCH_DEPTH)
     jax.block_until_ready(rep.trees)
 
     chunks = []  # per-chunk (tuples/s, windows/s)
@@ -551,6 +578,7 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
         t0 = time.perf_counter()
         for b in batches[lo:lo + n_batches]:
             rep.handle_msg(0, b)
+        rep.dispatch.drain()  # the chunk's windows must be EMITTED
         jax.block_until_ready(rep.trees)
         elapsed = time.perf_counter() - t0
         chunks.append((n_batches * B / elapsed,
@@ -560,10 +588,13 @@ def _run_config(n_keys: int, win_per_batch: int, n_batches: int,
     for b in batches[WARMUP + repeats * n_batches:]:
         # drain the dispatch queue first so a firing batch's timing does
         # not absorb async backlog from preceding non-firing batches
+        rep.dispatch.drain()
         jax.block_until_ready(rep.trees)
         before = sink.windows
         tb = time.perf_counter()
         rep.handle_msg(0, b)
+        rep.dispatch.drain()  # latency = fire-to-DELIVERY, so the
+        # deferred commit (and its emit) belongs inside the timed region
         if sink.windows > before:  # this batch fired windows
             _sync(sink)  # windows DELIVERED, not merely dispatched
             fire_lat.append(time.perf_counter() - tb)
@@ -599,6 +630,7 @@ def _run_op_config(make_op, n_keys: int, n_batches: int,
                         with_ts=False)
     for b in bs[:WARMUP]:
         rep.handle_msg(0, b)
+    rep.dispatch.drain()
     _sync(sink)  # warmup compute must not bleed into the timed region
     best = 0.0
     for r in range(repeats):
@@ -606,6 +638,7 @@ def _run_op_config(make_op, n_keys: int, n_batches: int,
         t0 = time.perf_counter()
         for b in bs[lo:lo + n_batches]:
             rep.handle_msg(0, b)
+        rep.dispatch.drain()  # deferred commits must emit to count
         _sync(sink)
         best = max(best, n_batches * BATCH / (time.perf_counter() - t0))
     return best
@@ -665,17 +698,23 @@ def _ab_mode(pin_sha: str) -> None:
                       f"{p.stderr.strip().splitlines()[-3:]}",
                       file=sys.stderr)
                 sys.exit(2)
-            if not isinstance(r.get("value"), (int, float)):
-                print(f"bench-ab: {label} pass JSON has no numeric "
-                      f"'value' ({script}); a pre-r3 pin lacks the "
+            if not isinstance(r.get("value"), (int, float)) \
+                    or r["value"] <= 0:
+                # a non-positive value would divide (or zero) the paired
+                # delta below — same invalid-pass handling as no value
+                print(f"bench-ab: {label} pass JSON has no usable "
+                      f"numeric 'value' (got {r.get('value')!r}, "
+                      f"{script}); a pre-r3 pin lacks the "
                       "shared protocol — pick a pin at or after "
                       f"{AB_PIN_SHA}", file=sys.stderr)
                 sys.exit(2)
             v16 = r.get("tuples_per_sec_16k_batches")
             runs[label].append({
                 "value": r["value"],
+                # non-positive 16k sides drop the pair's 16k delta
+                # instead of crashing the whole A/B after both passes
                 "value_16k": v16 if isinstance(v16, (int, float))
-                else None,
+                and v16 > 0 else None,
             })
             print(f"bench-ab:   {label} mean {r['value']:,.0f} t/s "
                   f"(16k: {v16 if v16 is None else format(v16, ',.0f')})",

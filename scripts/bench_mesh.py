@@ -82,6 +82,7 @@ def main() -> None:
 
     for b in batches[:WARMUP]:
         rep.handle_msg(0, b)
+    rep.dispatch.drain()  # commit deferred batches (WF_DISPATCH_DEPTH)
     jax.block_until_ready(rep._state[0])
 
     chunks = []
@@ -91,6 +92,7 @@ def main() -> None:
         t0 = time.perf_counter()
         for b in batches[lo:lo + N_BATCHES]:
             rep.handle_msg(0, b)
+        rep.dispatch.drain()  # the chunk's windows must be EMITTED
         jax.block_until_ready(rep._state[0])
         el = time.perf_counter() - t0
         chunks.append((N_BATCHES * BATCH / el, (sink.windows - w0) / el))

@@ -190,6 +190,107 @@ def bench_exit_pipeline() -> None:
                batches * n / (time.perf_counter() - t0), "rows/sec")
 
 
+def bench_dispatch() -> None:
+    """--dispatch: the device-ahead dispatch pipeline (WF_DISPATCH_DEPTH,
+    runtime/dispatch.py) on the FFAT per-batch path. Reports throughput
+    at depth 0 (synchronous prep+commit) vs the default depth 2, the
+    per-stage split from the stats counters (host-prep µs vs
+    device-commit µs per batch), and the overlap efficiency — the
+    fraction of the smaller stage's total time hidden under the larger
+    one, ((prep + commit) - wall) / min(prep, commit), 0 when the stages
+    fully serialize and 1 when one is completely hidden."""
+    import jax
+
+    from windflow_tpu.basic import WinType
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.ffat_tpu import Ffat_Windows_TPU
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    N_KEYS, B, NB, WARMUP = 64, 16384, 24, 4
+    WIN_US, SLIDE_US, TS_STEP = 100_000, 25_000, 50
+    schema = TupleSchema({"key": np.int32, "value": np.int32})
+    rng = np.random.default_rng(0)
+    batches = []
+    ts0 = 0
+    for _ in range(NB + WARMUP):
+        keys = rng.integers(0, N_KEYS, B).astype(np.int64)
+        cols = {"key": jax.device_put(keys.astype(np.int32)),
+                "value": jax.device_put(
+                    rng.integers(0, 100, B).astype(np.int32))}
+        ts = ts0 + np.arange(B, dtype=np.int64) * TS_STEP // N_KEYS
+        ts0 = int(ts[-1]) + TS_STEP
+        bt = BatchTPU(cols, ts, B, schema, wm=int(ts[-1]), host_keys=keys)
+        batches.append(bt)
+
+    class _Sink:
+        windows = 0
+
+        def emit_device_batch(self, b):
+            self.windows += b.size
+
+        def set_stats(self, s):
+            pass
+
+    results = {}
+    prev = os.environ.get("WF_DISPATCH_DEPTH")
+    try:
+        for depth in (0, 2):
+            os.environ["WF_DISPATCH_DEPTH"] = str(depth)
+            op = Ffat_Windows_TPU(
+                lift=lambda f: {"value": f["value"]},
+                combine=lambda a, b: {"value": a["value"] + b["value"]},
+                key_extractor="key", win_len=WIN_US, slide_len=SLIDE_US,
+                win_type=WinType.TB, num_win_per_batch=128,
+                key_capacity=N_KEYS, name=f"mb_dispatch_d{depth}")
+            op.build_replicas()
+            rep = op.replicas[0]
+            rep.emitter = _Sink()
+            for bt in batches[:WARMUP]:
+                rep.handle_msg(0, bt)
+            rep.dispatch.drain()
+            jax.block_until_ready(rep.trees)
+            st = rep.stats
+            prep0, commit0 = (st.dispatch_host_prep_total_us,
+                              st.dispatch_commit_total_us)
+            t0 = time.perf_counter()
+            for bt in batches[WARMUP:]:
+                rep.handle_msg(0, bt)
+            rep.dispatch.drain()
+            jax.block_until_ready(rep.trees)
+            wall_us = (time.perf_counter() - t0) * 1e6
+            results[depth] = (NB * B / (wall_us / 1e6), wall_us,
+                              st.dispatch_host_prep_total_us - prep0,
+                              st.dispatch_commit_total_us - commit0,
+                              st.dispatch_stalls, st.dispatch_depth_max)
+    finally:
+        if prev is None:
+            os.environ.pop("WF_DISPATCH_DEPTH", None)
+        else:
+            os.environ["WF_DISPATCH_DEPTH"] = prev
+
+    for depth, (tps, _w, _p, _c, _s, _d) in results.items():
+        report(f"dispatch_ffat_depth{depth}", tps)
+    tps0, wall, prep_us, commit_us, stalls, dmax = results[2]
+    report("dispatch_host_prep_us_per_batch", prep_us / NB, "usec")
+    report("dispatch_commit_us_per_batch", commit_us / NB, "usec")
+    denom = min(prep_us, commit_us)
+    overlap = (max(0.0, min(1.0, (prep_us + commit_us - wall) / denom))
+               if denom > 0 else 0.0)
+    # ratios need 3 decimals (report() rounds to 1 for throughputs)
+    print(json.dumps({"bench": "dispatch_overlap_efficiency",
+                      "value": round(overlap, 3), "unit": "ratio"}))
+    print(json.dumps({"bench": "dispatch_depth2_vs_depth0",
+                      "value": (round(results[2][0] / results[0][0], 3)
+                                if results[0][0] else 0.0),
+                      "unit": "speedup"}))
+    print(json.dumps({"bench": "dispatch_pipeline_detail",
+                      "readback_stalls": stalls,
+                      "queue_depth_max": dmax,
+                      "wall_us": round(wall, 1),
+                      "host_prep_total_us": round(prep_us, 1),
+                      "device_commit_total_us": round(commit_us, 1)}))
+
+
 def bench_cpu_plane() -> None:
     """Per-tuple Python plane: 3-op chain end-to-end (the CPU plane is
     functor-bound by design; the device plane is the throughput story)."""
@@ -216,11 +317,15 @@ def bench_cpu_plane() -> None:
 
 
 def main() -> None:
+    if "--dispatch" in sys.argv[1:]:
+        bench_dispatch()
+        return
     bench_staging()
     bench_reshard()
     bench_channels()
     bench_exit_decode()
     bench_exit_pipeline()
+    bench_dispatch()
     bench_cpu_plane()
 
 

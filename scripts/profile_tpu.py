@@ -111,12 +111,14 @@ def main() -> None:
     batches = bench._stage_batches(bench.N_KEYS, 40, 0, with_ts=True)
     for b in batches[:4]:
         rep.handle_msg(0, b)
+    rep.dispatch.drain()  # commit deferred batches (WF_DISPATCH_DEPTH)
     jax.block_until_ready(rep.trees)
 
     # (a) full path, pipelined (bench's throughput mode)
     t0 = time.perf_counter()
     for b in batches[4:]:
         rep.handle_msg(0, b)
+    rep.dispatch.drain()
     jax.block_until_ready(rep.trees)
     full = (time.perf_counter() - t0) / 36
     per_batch = batches[0].size
@@ -133,11 +135,13 @@ def main() -> None:
     b2 = bench._stage_batches(bench.N_KEYS, 40, 0, with_ts=True)
     for b in b2[:4]:
         rep2.handle_msg(0, b)
+    rep2.dispatch.drain()
     jax.block_until_ready(rep2.trees)
     pr = cProfile.Profile()
     pr.enable()
     for b in b2[4:]:
         rep2.handle_msg(0, b)
+    rep2.dispatch.drain()
     pr.disable()
     jax.block_until_ready(rep2.trees)
     st = pstats.Stats(pr)

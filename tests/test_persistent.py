@@ -9,9 +9,11 @@ import pytest
 
 from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder, PipeGraph,
                           Sink_Builder, Source_Builder, TimePolicy)
-from windflow_tpu.persistent import (DBHandle, LRUStore, P_Keyed_Windows_Builder,
+from windflow_tpu.persistent import (DBHandle, LFUCache, LRUStore,
+                                     P_Keyed_Windows_Builder,
                                      P_Map_Builder, P_Reduce_Builder,
                                      P_Sink_Builder)
+from windflow_tpu.persistent.cache import LRUCache
 
 from common import GlobalSum, TupleT, WinCollector, expected_windows, \
     make_ingress_source, make_sum_sink
@@ -49,6 +51,104 @@ def test_lru_store_spill_and_reload(db_dir):
     store.flush()
     assert sorted(k for k in db.keys()) == list(range(10))
     db.close()
+
+
+def test_lfu_eviction_order_vs_lru():
+    """The policies diverge exactly where they should: on the SAME
+    access trace LRU evicts the least-RECENT key even though it is the
+    hottest, while LFU keeps it and evicts the least-FREQUENT one."""
+    trace_evictions = {}
+    for name, cls in (("lru", LRUCache), ("lfu", LFUCache)):
+        evicted = []
+        c = cls(3, on_evict=lambda k, v: evicted.append(k))
+        c.put("a", 1)
+        # 'a' becomes hot FIRST, then goes quiet while b/c arrive
+        assert c.get("a") == 1 and c.get("a") == 1 and c.get("a") == 1
+        c.put("b", 2)
+        c.put("c", 3)
+        c.put("d", 4)
+        trace_evictions[name] = list(evicted)
+    # least recent is the hot 'a'; least frequent is 'b' (freq 1, and
+    # older than the equally-cold 'c' — the LRU tie-break inside LFU)
+    assert trace_evictions["lru"] == ["a"]
+    assert trace_evictions["lfu"] == ["b"]
+
+
+def test_lfu_tie_break_is_lru_within_frequency():
+    evicted = []
+    c = LFUCache(2, on_evict=lambda k, v: evicted.append(k))
+    c.put("x", 1)
+    c.put("y", 2)  # both frequency 1; 'x' is the older insertion
+    c.put("z", 3)
+    assert evicted == ["x"]
+    assert "y" in c and "z" in c
+
+
+def test_lfu_frequency_survives_update_and_pop():
+    c = LFUCache(2)
+    c.put("x", 1)
+    c.get("x")
+    c.put("x", 10)  # update bumps frequency, replaces value
+    assert c.get("x") == 10
+    c.put("y", 2)
+    evicted = []
+    c.on_evict = lambda k, v: evicted.append((k, v))
+    c.put("z", 3)  # 'y' (freq 1) evicts before hot 'x'
+    assert evicted == [("y", 2)]
+    assert c.pop("x") == 10 and "x" not in c
+    assert c.pop("missing", "dflt") == "dflt"
+    assert len(c) == 1 and sorted(c.keys()) == ["z"]
+
+
+def test_lfu_store_spill_and_reload(db_dir):
+    """LRUStore with policy="lfu": hot keys stay resident under cache
+    pressure; evictions spill and reload from the DB like the LRU
+    variant (same store contract, different victim choice)."""
+    db = DBHandle("t_lfu", db_dir=db_dir)
+    store = LRUStore(db, capacity=2, policy="lfu")
+    store["hot"] = "H"
+    for _ in range(5):
+        assert store["hot"] == "H"
+    for i in range(10):
+        store[i] = [i]
+    # the hot key was never the LFU victim: still cached, zero DB hits
+    assert "hot" in store.cache
+    assert store["hot"] == "H"
+    assert len(store) == 11
+    store.flush()
+    assert sorted(map(str, db.keys())) == sorted(
+        map(str, list(range(10)) + ["hot"]))
+    db.close()
+
+
+def test_unknown_cache_policy_rejected_at_build_time():
+    from windflow_tpu import WindFlowError
+    with pytest.raises(WindFlowError, match="unknown cache policy"):
+        P_Map_Builder(lambda t, s: (t, s)).with_cache_policy("mru")
+
+
+def test_p_map_lfu_policy_matches_lru(db_dir):
+    """Same P_Map pipeline under both cache policies: state correctness
+    must be policy-independent (the cache only decides residency)."""
+    totals = {}
+    for policy in ("lru", "lfu"):
+        acc = GlobalSum()
+        graph = PipeGraph(f"pmap_{policy}")
+        src = Source_Builder(make_ingress_source(8, 30)).build()
+
+        def number(t, state):
+            state["n"] += 1
+            return TupleT(t.key, state["n"]), state
+
+        pm = (P_Map_Builder(number).with_key_by(lambda t: t.key)
+              .with_initial_state({"n": 0}).with_db_path(db_dir)
+              .with_cache_capacity(2).with_cache_policy(policy)
+              .with_name(f"pmap_{policy}").build())
+        graph.add_source(src).add(pm).add_sink(
+            Sink_Builder(make_sum_sink(acc)).build())
+        graph.run()
+        totals[policy] = acc.value
+    assert totals["lru"] == totals["lfu"] == 8 * sum(range(1, 31))
 
 
 def test_p_map_running_state(db_dir):

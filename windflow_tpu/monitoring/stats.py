@@ -24,6 +24,9 @@ class StatsRecord:
         "device_batches_in", "device_batches_out",
         "device_bytes_h2d", "device_bytes_d2h", "device_programs_run",
         "staging_pool_hits", "staging_pool_misses",
+        "dispatch_host_prep_us", "dispatch_commit_us",
+        "dispatch_host_prep_total_us", "dispatch_commit_total_us",
+        "dispatch_batches", "dispatch_stalls", "dispatch_depth_max",
         "is_terminated", "_last_svc_start",
     )
 
@@ -47,6 +50,16 @@ class StatsRecord:
         self.device_programs_run = 0
         self.staging_pool_hits = 0  # recycled staging buffers (ArrayPool)
         self.staging_pool_misses = 0
+        # device-ahead dispatch pipeline (runtime/dispatch.py): per-stage
+        # split of the device-operator batch path — host control plane
+        # (prep) vs program dispatch + emit readbacks (commit)
+        self.dispatch_host_prep_us = 0.0  # EWMA
+        self.dispatch_commit_us = 0.0  # EWMA
+        self.dispatch_host_prep_total_us = 0.0
+        self.dispatch_commit_total_us = 0.0
+        self.dispatch_batches = 0
+        self.dispatch_stalls = 0  # forced ordering-point drains
+        self.dispatch_depth_max = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
 
@@ -62,6 +75,31 @@ class StatsRecord:
         else:
             self.service_time_us += _EWMA_ALPHA * (per_tuple - self.service_time_us)
         self.eff_service_time_us = self.service_time_us
+
+    # -- dispatch-pipeline stages (runtime/dispatch.py) ----------------------
+    def note_host_prep(self, us: float) -> None:
+        self.dispatch_batches += 1
+        self.dispatch_host_prep_total_us += us
+        if self.dispatch_host_prep_us == 0.0:
+            self.dispatch_host_prep_us = us
+        else:
+            self.dispatch_host_prep_us += _EWMA_ALPHA * (
+                us - self.dispatch_host_prep_us)
+
+    def note_dispatch_commit(self, us: float) -> None:
+        self.dispatch_commit_total_us += us
+        if self.dispatch_commit_us == 0.0:
+            self.dispatch_commit_us = us
+        else:
+            self.dispatch_commit_us += _EWMA_ALPHA * (
+                us - self.dispatch_commit_us)
+
+    def note_dispatch_depth(self, depth: int) -> None:
+        if depth > self.dispatch_depth_max:
+            self.dispatch_depth_max = depth
+
+    def note_dispatch_stall(self) -> None:
+        self.dispatch_stalls += 1
 
     def to_dict(self) -> Dict[str, Any]:
         elapsed = max(time.monotonic() - self.start_time, 1e-9)
@@ -85,5 +123,14 @@ class StatsRecord:
             "Device_programs_run": self.device_programs_run,
             "Staging_pool_hits": self.staging_pool_hits,
             "Staging_pool_misses": self.staging_pool_misses,
+            "Dispatch_host_prep_usec": round(self.dispatch_host_prep_us, 3),
+            "Dispatch_commit_usec": round(self.dispatch_commit_us, 3),
+            "Dispatch_host_prep_total_usec": round(
+                self.dispatch_host_prep_total_us, 1),
+            "Dispatch_commit_total_usec": round(
+                self.dispatch_commit_total_us, 1),
+            "Dispatch_batches": self.dispatch_batches,
+            "Dispatch_readback_stalls": self.dispatch_stalls,
+            "Dispatch_queue_depth_max": self.dispatch_depth_max,
             "isTerminated": self.is_terminated,
         }

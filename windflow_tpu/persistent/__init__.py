@@ -1,5 +1,5 @@
 from .db_handle import DBHandle
-from .cache import LRUCache, LRUStore
+from .cache import LFUCache, LRUCache, LRUStore
 from .p_basic_ops import (P_Filter, P_FlatMap, P_Map, P_Reduce, P_Sink)
 from .p_keyed_windows import P_Keyed_Windows
 from .builders_persistent import (P_Filter_Builder, P_FlatMap_Builder,
@@ -7,7 +7,7 @@ from .builders_persistent import (P_Filter_Builder, P_FlatMap_Builder,
                                   P_Reduce_Builder, P_Sink_Builder)
 
 __all__ = [
-    "DBHandle", "LRUCache", "LRUStore",
+    "DBHandle", "LFUCache", "LRUCache", "LRUStore",
     "P_Map", "P_Filter", "P_FlatMap", "P_Reduce", "P_Sink",
     "P_Keyed_Windows",
     "P_Map_Builder", "P_Filter_Builder", "P_FlatMap_Builder",

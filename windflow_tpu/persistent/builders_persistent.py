@@ -1,6 +1,9 @@
 """Builders for persistent operators (reference
 ``wf/persistent/builders_rocksdb.hpp``: withDBPath, withSerializer/
-Deserializer, withCacheCapacity on top of the usual surface)."""
+Deserializer, withCacheCapacity on top of the usual surface; the cache
+POLICY mirrors the reference's pluggable hot-buffer cache,
+``p_window_replica.hpp:121`` — LRU by default, LFU for skewed key
+distributions via ``with_cache_policy("lfu")``)."""
 
 from __future__ import annotations
 
@@ -19,6 +22,7 @@ class _PersistentBuilder(BasicBuilder):
         self._initial_state: Any = None
         self._db_dir: Optional[str] = None
         self._cache_capacity = 1024
+        self._cache_policy = "lru"
         self._serialize = None
         self._deserialize = None
 
@@ -38,6 +42,15 @@ class _PersistentBuilder(BasicBuilder):
         self._cache_capacity = n
         return self
 
+    def with_cache_policy(self, policy: str):
+        """Hot-cache eviction policy: "lru" (default) or "lfu" (keeps a
+        stable hot set under skewed key distributions). Validated here
+        so a typo fails at build time, not at the first eviction."""
+        from .cache import make_cache
+        make_cache(policy, 1)  # raises WindFlowError on unknown policy
+        self._cache_policy = policy
+        return self
+
     def with_serializers(self, serialize: Callable, deserialize: Callable):
         self._serialize = serialize
         self._deserialize = deserialize
@@ -51,7 +64,8 @@ class _PersistentBuilder(BasicBuilder):
         return self._finish(self.op_cls(
             self._func, self._key_extractor, self._initial_state, self._name,
             self._parallelism, self._output_batch_size, self._db_dir,
-            self._cache_capacity, self._serialize, self._deserialize))
+            self._cache_capacity, self._serialize, self._deserialize,
+            cache_policy=self._cache_policy))
 
 
 class P_Map_Builder(_PersistentBuilder):
@@ -122,4 +136,4 @@ class P_Keyed_Windows_Builder(_PersistentBuilder):
             self._win_type, self._lateness, self._incremental, self._initial,
             self._name, self._parallelism, self._output_batch_size,
             self._db_dir, self._cache_capacity, self._serialize,
-            self._deserialize))
+            self._deserialize, cache_policy=self._cache_policy))

@@ -31,7 +31,8 @@ class _PersistentOperator(BasicOperator):
                  name: str, parallelism: int, output_batch_size: int,
                  db_dir: Optional[str] = None, cache_capacity: int = 1024,
                  serialize=None, deserialize=None,
-                 input_routing: RoutingMode = RoutingMode.KEYBY) -> None:
+                 input_routing: RoutingMode = RoutingMode.KEYBY,
+                 cache_policy: str = "lru") -> None:
         if key_extractor is None:
             raise WindFlowError(f"{name}: persistent operators require a "
                                 "key extractor")
@@ -41,6 +42,7 @@ class _PersistentOperator(BasicOperator):
         self.initial_state = initial_state
         self.db_dir = db_dir
         self.cache_capacity = cache_capacity
+        self.cache_policy = cache_policy
         self.serialize = serialize
         self.deserialize = deserialize
         self._riched = arity(func) >= 3
@@ -61,7 +63,8 @@ class _PersistentReplica(BasicReplica):
         super().__init__(op, idx)
         self.db = DBHandle(f"{op.name}_r{idx}", op.serialize, op.deserialize,
                            op.db_dir)
-        self.state = LRUStore(self.db, op.cache_capacity)
+        self.state = LRUStore(self.db, op.cache_capacity,
+                              policy=op.cache_policy)
 
     def _get_state(self, key):
         try:

@@ -26,12 +26,14 @@ class P_Keyed_Windows(Keyed_Windows):
                  initial_value: Any = None, name: str = "p_keyed_windows",
                  parallelism: int = 1, output_batch_size: int = 0,
                  db_dir: Optional[str] = None, cache_capacity: int = 256,
-                 serialize=None, deserialize=None) -> None:
+                 serialize=None, deserialize=None,
+                 cache_policy: str = "lru") -> None:
         super().__init__(win_func, key_extractor, win_len, slide_len,
                          win_type, lateness, incremental, initial_value,
                          name, parallelism, output_batch_size)
         self.db_dir = db_dir
         self.cache_capacity = cache_capacity
+        self.cache_policy = cache_policy
         self.serialize = serialize
         self.deserialize = deserialize
 
@@ -46,7 +48,8 @@ class PKeyedWindowsReplica(_WindowReplica):
         self.db = DBHandle(f"{op.name}_r{idx}", op.serialize, op.deserialize,
                            op.db_dir)
         # swap the engine's key map for the cache-backed store
-        self.engine.key_map = LRUStore(self.db, op.cache_capacity)
+        self.engine.key_map = LRUStore(self.db, op.cache_capacity,
+                                       policy=op.cache_policy)
 
     def flush_on_termination(self) -> None:
         super().flush_on_termination()

@@ -18,7 +18,7 @@ from __future__ import annotations
 import os
 import threading
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -78,10 +78,18 @@ class InFlightRecycler:
     ``wf/batch_gpu_t.hpp:66``; double-buffered staging
     ``wf/keyby_emitter_gpu.hpp:443-505``)."""
 
-    def __init__(self, pool: ArrayPool, max_in_flight: int = 8,
+    def __init__(self, pool: ArrayPool, max_in_flight: Optional[int] = None,
                  force: bool = False) -> None:
         from collections import deque
         self.pool = pool
+        if max_in_flight is None:
+            # deferred device commits (WF_DISPATCH_DEPTH, the consumer's
+            # dispatch pipeline) park H2D reads behind queued programs:
+            # keep this FIFO comfortably deeper than the dispatch queue
+            # so the blocking pop lands on transfers whose programs have
+            # long since run instead of stalling on a parked one
+            from .runtime.dispatch import dispatch_depth
+            max_in_flight = max(8, 4 * dispatch_depth())
         self.max_in_flight = max_in_flight
         self._q = deque()  # (device arrays tuple, host buffers list)
         # Platform gate: the CPU backend's device_put may ALIAS the host

@@ -1,0 +1,128 @@
+"""DeviceDispatchQueue: the per-replica device-ahead dispatch pipeline.
+
+Every TPU replica's per-batch work has two halves:
+
+- a HOST-PREP stage — pure host control plane over the batch's host
+  metadata: key -> slot resolution, leaf/pane bookkeeping, fire-pack and
+  grid assembly (numpy, no device handles touched);
+- a DEVICE-COMMIT stage — the XLA program call(s) on the replica's
+  device state plus the downstream emit, including any readback the emit
+  path needs (compaction counts, routing columns).
+
+XLA's async dispatch already overlaps *device execution* with later host
+work, but the commit stage itself still serializes with the next batch's
+host prep: its Python-side program-call overhead, the donation hand-off
+of the replica's device state, and above all the emit path's readbacks
+(an ``np.asarray``/``int()`` on a fresh program output blocks until that
+program ran). This queue defers the commit stage of up to ``depth``
+batches, mirroring ``_D2HPipeline`` on the exit edges: by the time a
+commit is popped, ``depth`` later batches have been prepped and the
+deferred readbacks land on long-materialized results instead of
+stalling. ``WF_DISPATCH_DEPTH=0`` restores the fully synchronous path
+(commit runs inside ``submit``), which the differential tests pin
+against depth >= 2 for exact result equality.
+
+Ordering contract: commits run strictly in submission order, on the
+replica's own worker thread (no cross-thread hand-off — the queue is a
+deferral buffer, not a concurrency primitive). The replica drains it at
+every ordering point: before punctuation propagates, at EOS/terminate,
+before any host code touches the replica's device state (forest/table
+growth, program warm-up), and on the worker's idle tick so a quiet
+stream never parks prepared batches. A commit that raises marks the
+pipeline broken and discards the remaining entries — they were prepped
+against control-plane state the failed batch already advanced, so
+re-running them after the error would emit from an inconsistent forest;
+the error itself unwinds the worker (drain-inputs + emergency EOS).
+
+Per-stage instrumentation lands in the replica's ``StatsRecord``
+(``Dispatch_host_prep_usec`` / ``Dispatch_commit_usec`` EWMAs + totals,
+forced-drain stall count, max queue depth) so the host-prep/device split
+is measured, not asserted — ``scripts/microbench.py --dispatch`` reports
+the split and the overlap efficiency it buys.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Optional
+
+_DEFAULT_DEPTH = 2
+
+
+def dispatch_depth(default: int = _DEFAULT_DEPTH) -> int:
+    """The configured pipeline depth (``WF_DISPATCH_DEPTH``, default 2;
+    0 = synchronous). Malformed values fall back to the default — a bad
+    knob must not take down the graph."""
+    try:
+        return max(0, int(os.environ.get("WF_DISPATCH_DEPTH",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+class DeviceDispatchQueue:
+    """Bounded FIFO of deferred device-commit thunks (see module doc)."""
+
+    def __init__(self, stats=None, depth: Optional[int] = None) -> None:
+        self.depth = dispatch_depth() if depth is None else max(0, depth)
+        self.stats = stats
+        self._q: "deque[Callable[[], None]]" = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    # ------------------------------------------------------------------
+    def submit(self, commit: Callable[[], None],
+               prep_us: float = 0.0) -> None:
+        """Record the host-prep time and queue (or, at depth 0, run) one
+        batch's device-commit stage. Overflowing ``depth`` commits the
+        oldest entry — the blocking pop that gives the pipeline its
+        bounded lag."""
+        if self.stats is not None:
+            self.stats.note_host_prep(prep_us)
+        if self.depth == 0:
+            self._run(commit)
+            return
+        self._q.append(commit)
+        while len(self._q) > self.depth:
+            self._run(self._q.popleft())
+        if self.stats is not None:
+            self.stats.note_dispatch_depth(len(self._q))
+
+    def drain(self, forced: bool = False) -> None:
+        """Commit everything in flight. ``forced=True`` marks an
+        ordering-point drain (punctuation/EOS/device-state access) in the
+        stats as a readback stall — the pipeline had to give up its lag."""
+        if forced and self._q and self.stats is not None:
+            self.stats.note_dispatch_stall()
+        while self._q:
+            self._run(self._q.popleft())
+
+    def on_idle(self) -> bool:
+        """Worker idle tick: a quiet stream must not park prepared
+        batches (same contract as ``_D2HPipeline.on_idle``). Returns
+        whether anything was committed (drives the worker's backoff)."""
+        had = bool(self._q)
+        self.drain()
+        return had
+
+    def abort(self) -> None:
+        """Discard pending commits WITHOUT running them (error unwind:
+        the entries were prepped against control-plane state the failed
+        batch already advanced)."""
+        self._q.clear()
+
+    # ------------------------------------------------------------------
+    def _run(self, commit: Callable[[], None]) -> None:
+        t0 = time.perf_counter()
+        try:
+            commit()
+        except BaseException:
+            self.abort()
+            raise
+        finally:
+            if self.stats is not None:
+                self.stats.note_dispatch_commit(
+                    (time.perf_counter() - t0) * 1e6)

@@ -62,20 +62,27 @@ class Worker(threading.Thread):
             return
         n_inputs = self.channel.n_inputs
         has_coll = hasattr(head, "on_channel_eos")
-        # emitters that pipeline work (D2H FIFOs) must not withhold
-        # results forever on an idle stream: poll with a timeout and give
-        # them an idle tick when the channel stays quiet
+        # anything that pipelines work (replica dispatch queues, emitter
+        # D2H FIFOs) must not withhold results forever on an idle stream:
+        # poll with a timeout and give it an idle tick when the channel
+        # stays quiet. Chain order, node before its emitter — a drained
+        # dispatch queue emits INTO the emitter's FIFO, which the same
+        # tick then delivers.
         import os
 
-        idle_emitters = [em for node in self.chain
-                         if (em := getattr(node, "emitter", None)) is not None
-                         and hasattr(em, "on_idle")]
+        idle_sinks = []
+        for node in self.chain:
+            if hasattr(node, "on_idle"):
+                idle_sinks.append(node)
+            em = getattr(node, "emitter", None)
+            if em is not None and hasattr(em, "on_idle"):
+                idle_sinks.append(em)
         try:
             idle_ms = float(os.environ.get("WF_IDLE_DRAIN_MS", "50"))
         except ValueError:
             idle_ms = 50.0  # malformed knob must not take down the graph
         # <= 0 disables the tick (a 0 timeout would busy-spin when idle)
-        idle_s = idle_ms / 1e3 if idle_emitters and idle_ms > 0 else None
+        idle_s = idle_ms / 1e3 if idle_sinks and idle_ms > 0 else None
         # back off (up to 16x) when consecutive idle ticks find nothing to
         # drain, so a fully idle graph doesn't wake every worker at 20 Hz
         # on a small host; any real message resets the cadence
@@ -86,8 +93,8 @@ class Worker(threading.Thread):
             item = self.channel.get(backoff)
             if item is None:  # idle tick
                 did_work = False
-                for em in idle_emitters:
-                    did_work = bool(em.on_idle()) or did_work
+                for sink in idle_sinks:
+                    did_work = bool(sink.on_idle()) or did_work
                 idle_streak = 0 if did_work else idle_streak + 1
                 continue
             idle_streak = 0

@@ -39,6 +39,14 @@ def key_column_to_list(batch: "BatchTPU", field: str) -> list:
     return np.asarray(batch.fields[field])[:batch.size].tolist()
 
 
+def key_column_np(batch: "BatchTPU", field: str) -> np.ndarray:
+    """D2H of the key column as the RAW numpy array — the vectorized
+    twin of ``key_column_to_list`` for consumers that never materialize
+    Python keys (the dispatch pipeline's host-prep stage: tolist +
+    re-asarray would box every key twice per batch)."""
+    return np.asarray(batch.fields[field])[:batch.size]
+
+
 def bucket_capacity(n: int, minimum: int = 8) -> int:
     c = minimum
     while c < n:

@@ -29,7 +29,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from ..basic import ExecutionMode
+from ..basic import ExecutionMode, WindFlowError
 from ..message import Batch
 from ..runtime.emitters import BasicEmitter
 from .batch import BatchTPU, bucket_capacity
@@ -542,10 +542,21 @@ def _composite_key_dests(fcols: List[np.ndarray], n: int,
     return _vector_key_dests(st, n, num_dests)
 
 
-def _stack_key_fields(cols, key_fields, n: int):
+def _stack_key_fields(cols, key_fields, n: int,
+                      where: str = "push_columns (keyby staging edge)"):
     """Structured key column for a composite key: the structured rows
     (.item()) are the same tuples the per-row path extracts, so
-    downstream slot maps unify both forms of one key."""
+    downstream slot maps unify both forms of one key. Raises a
+    descriptive WindFlowError (mirroring ``composite_keys_from_device``)
+    instead of a bare KeyError when a key field is missing from the
+    pushed columns."""
+    missing = [f for f in key_fields if f not in cols]
+    if missing:
+        raise WindFlowError(
+            f"{where}: composite key field(s) "
+            f"{', '.join(repr(f) for f in missing)} missing from the "
+            f"pushed columns (have: {sorted(map(str, cols))}); every "
+            "field of a composite key must be present as a column")
     fcols = [np.asarray(cols[f])[:n] for f in key_fields]
     kcol = np.empty(n, np.dtype(
         [(f, c.dtype) for f, c in zip(key_fields, fcols)]))

@@ -529,7 +529,10 @@ class FfatTPUReplica(TPUReplicaBase):
 
     def _ensure_rebuilt(self) -> None:
         """Run the standalone rebuild iff ingest-only batches deferred
-        it (idempotent: rebuilding from current leaves is always safe)."""
+        it (idempotent: rebuilding from current leaves is always safe).
+        Both the dirty flag and the forest belong to the commit stage,
+        so in-flight commits must land before reading either."""
+        self.dispatch.drain(forced=True)
         if not self._rebuild_dirty or self.trees is None:
             return
         from .ops_tpu import cached_compile
@@ -576,6 +579,9 @@ class FfatTPUReplica(TPUReplicaBase):
         retry (which re-enters growth from scratch)."""
         import jax
         import jax.numpy as jnp
+        # growth reads the CURRENT forest: deferred commits reassign
+        # trees/tvalid (donation), so they must land first
+        self.dispatch.drain(forced=True)
         old = self.K_cap
         new_cap = old * 2
         grown = {}
@@ -605,6 +611,9 @@ class FfatTPUReplica(TPUReplicaBase):
         forest commit together, after the fallible allocations)."""
         import jax
         import jax.numpy as jnp
+        # same ordering rule as _grow_keys: the migration reads the
+        # current forest, so deferred commits must land first
+        self.dispatch.drain(forced=True)
         old_F = self.F
         new_F = old_F
         while needed_span >= new_F:
@@ -653,16 +662,21 @@ class FfatTPUReplica(TPUReplicaBase):
         self.tvalid = jnp.zeros((self.K_cap, 2 * self.F), bool)
 
     # ------------------------------------------------------------------
-    def process_device_batch(self, batch: BatchTPU) -> None:
+    def prep_device_batch(self, batch: BatchTPU):
+        """HOST-PREP stage of the dispatch pipeline: everything here runs
+        on host metadata only (slot resolution, leaf bookkeeping, window
+        fire decisions, fire-pack assembly) and never waits on a device
+        result — so it overlaps the deferred device commits of earlier
+        batches. Paths that must touch the replica's device forest
+        (growth, program warm-up) drain the pipeline first."""
         op = self.op
         n = batch.size
         if n == 0:
-            return
+            return None
         self._ensure_forest(batch.fields)
         if op.key_field is not None and op.key_field in batch.fields:
             self._key_dtype = np.dtype(batch.fields[op.key_field].dtype)
-        keys = self.batch_keys(batch)
-        keys_arr = np.asarray(keys)
+        keys, keys_arr = self.batch_keys_np(batch)
         slots = self._slots_of(keys, keys_arr, n)
         if op.win_type is WinType.TB:
             leaves = batch.ts_host[:n] // op.pane_len
@@ -763,8 +777,8 @@ class FfatTPUReplica(TPUReplicaBase):
 
         frontier = (max(0, batch.wm - op.lateness) // op.pane_len
                     if op.win_type is WinType.TB else None)
-        self._run_step(batch.fields, batch.wm, cap, comp_p,
-                       order_p, same_p, end_p, flat_p, frontier)
+        return self._prep_step(batch.fields, batch.wm, cap, comp_p,
+                               order_p, same_p, end_p, flat_p, frontier)
 
     # ------------------------------------------------------------------
     def _fireable(self, frontier, partial: bool, budget: int):
@@ -979,8 +993,14 @@ class FfatTPUReplica(TPUReplicaBase):
         if rb is not None:
             self.trees, self.tvalid = rb(self.trees, self.tvalid)
 
-    def _run_step(self, fields, wm, cap, comp_p,
-                  order_p, same_p, end_p, flat_p, frontier) -> None:
+    def _prep_step(self, fields, wm, cap, comp_p,
+                   order_p, same_p, end_p, flat_p, frontier):
+        """Host half of the per-batch step: program warm-up, the ENTIRE
+        fire plan — every drain iteration's chunk arrays and packed
+        fire/evict args, computed up front because ``_fireable`` reads
+        host metadata only (no control decision ever waits on a device
+        result) — and the fire-rate EWMA. Returns the device-commit
+        thunk for the dispatch pipeline."""
         if order_p is None:  # device mode: cached 1-elem dummies
             if self._seg_dummy is None:
                 import jax
@@ -996,9 +1016,12 @@ class FfatTPUReplica(TPUReplicaBase):
             # first batch of this capacity bucket: compile EVERY program
             # variant now (full both tiers, ingest-only, fire-only,
             # standalone rebuild) so no later batch — firing or not —
-            # pays a mid-stream compile
+            # pays a mid-stream compile. The warm-up's no-op runs consume
+            # the live forest (donation), so in-flight commits land first
+            self.dispatch.drain(forced=True)
             self._warm_programs(cap, ckey, ikey, fields, order_p, same_p,
                                 end_p, flat_p, ktable)
+        plan: List[Any] = []
         first = True
         total_fired = 0
         first_budget = self._first_budget()
@@ -1009,8 +1032,42 @@ class FfatTPUReplica(TPUReplicaBase):
             if not first and not n_out:
                 break
             if first and not n_out:
-                # nothing fireable: ingest-only program, rebuild DEFERRED
-                # to the next firing/rebuild program (the rebuild cost is
+                # nothing fireable: ingest-only program (None sentinel
+                # in the plan), rebuild DEFERRED to the next
+                # firing/rebuild program
+                plan.append(None)
+                break
+            f_pack, e_pack = self._pack_fire_arrays(chunks, n_out, budget)
+            plan.append((first, chunks, n_out, f_pack, e_pack, budget))
+            total_fired += n_out
+            first = False
+            if n_out < budget:
+                break
+        # fast-rise / slow-decay: a burst switches to the wide tier on
+        # the very next batch (both tier shapes are already compiled),
+        # while decay back to the small tier is smoothed
+        if total_fired > self._fire_ewma:
+            self._fire_ewma = float(total_fired)
+        else:
+            self._fire_ewma += 0.25 * (total_fired - self._fire_ewma)
+        seg = (comp_p, order_p, same_p, end_p, flat_p)
+        return lambda: self._commit_step(fields, wm, seg, ktable,
+                                         ckey, ikey, plan)
+
+    def _commit_step(self, fields, wm, seg, ktable, ckey, ikey,
+                     plan) -> None:
+        """Device half: runs the planned program sequence in order and
+        emits each iteration's windows. Reads ``self.trees``/
+        ``self.tvalid`` at COMMIT time — earlier queued commits reassign
+        them through donation — and owns the ``_rebuild_dirty`` flag
+        updates: they must land in DEVICE order (a later batch's prep
+        running before this commit must not see, or clobber, a stale
+        flag)."""
+        comp_p, order_p, same_p, end_p, flat_p = seg
+        for entry in plan:
+            if entry is None:
+                # ingest-only: leaves current, internal nodes stale until
+                # the next firing/rebuild program (the rebuild cost is
                 # batch-size-independent — the dominant per-batch term of
                 # the low-cardinality small-batch regime). Fire args are
                 # unused in this variant but still traced: pin the
@@ -1021,9 +1078,9 @@ class FfatTPUReplica(TPUReplicaBase):
                     self.trees, self.tvalid, zf, ktable, ze)
                 self._rebuild_dirty = True
                 self.stats.device_programs_run += 1
-                break
-            f_pack, e_pack = self._pack_fire_arrays(chunks, n_out, budget)
-            if first:
+                continue
+            is_first, chunks, n_out, f_pack, e_pack, budget = entry
+            if is_first:
                 # full program: lift + scan + scatter + rebuild + fire
                 (self.trees, self.tvalid, qr, qv, wid_dev,
                  key_dev) = self._prog_cache[ckey](
@@ -1040,17 +1097,6 @@ class FfatTPUReplica(TPUReplicaBase):
             self.stats.device_programs_run += 1
             self._emit_windows(wm, chunks, n_out, qr, qv,
                                wid_dev, key_dev, budget)
-            total_fired += n_out
-            first = False
-            if n_out < budget:
-                break
-        # fast-rise / slow-decay: a burst switches to the wide tier on
-        # the very next batch (both tier shapes are already compiled),
-        # while decay back to the small tier is smoothed
-        if total_fired > self._fire_ewma:
-            self._fire_ewma = float(total_fired)
-        else:
-            self._fire_ewma += 0.25 * (total_fired - self._fire_ewma)
 
     def _emit_windows(self, wm, chunks, n_out, qr, qv,
                       wid_dev, key_dev, W: int) -> None:
@@ -1094,6 +1140,10 @@ class FfatTPUReplica(TPUReplicaBase):
         fire-only program is sound only over a rebuilt forest."""
         if self.trees is None:
             return
+        # ordering: windows of deferred batches must emit before any
+        # dataless firing (handle_msg/terminate drain already, but
+        # direct drivers — bench, profile scripts — reach here too)
+        self.dispatch.drain(forced=True)
         while True:
             chunks = self._fireable(frontier, partial, self.W_cap)
             n_out = int(chunks[2].sum())

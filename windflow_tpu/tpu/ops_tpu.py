@@ -28,13 +28,15 @@ Siblings of the reference CUDA operators (``wf/map_gpu.hpp``,
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
 from ..operators.base import BasicOperator, BasicReplica
-from .batch import BatchTPU, key_column_to_list
+from ..runtime.dispatch import DeviceDispatchQueue
+from .batch import BatchTPU, key_column_np, key_column_to_list
 from .schema import TupleSchema
 
 
@@ -69,12 +71,27 @@ def cached_compile(cache: Dict, lock, key, make):
 # shared replica machinery
 # ---------------------------------------------------------------------------
 class TPUReplicaBase(BasicReplica):
-    """Processes whole device batches; never iterates rows."""
+    """Processes whole device batches; never iterates rows.
+
+    Batch processing is SPLIT into a host-prep stage and a device-commit
+    stage pipelined through a per-replica ``DeviceDispatchQueue``
+    (``WF_DISPATCH_DEPTH``, default 2): ``prep_device_batch`` runs the
+    host control plane for batch N+1 while batch N's program dispatch and
+    emit readbacks sit deferred in the queue. The queue drains at every
+    ordering point (punctuation, EOS/terminate, worker idle tick) and
+    whenever host code must touch the replica's device state."""
+
+    def __init__(self, op: BasicOperator, idx: int) -> None:
+        super().__init__(op, idx)
+        self.dispatch = DeviceDispatchQueue(stats=self.stats)
 
     def handle_msg(self, ch: int, msg: Any) -> None:
         if msg.is_punct:
             self.stats.punct_received += 1
             self._advance_wm(msg.wm)
+            # in-flight batches emit BEFORE the punctuation propagates
+            # (watermark monotonicity downstream)
+            self.dispatch.drain(forced=True)
             self.on_punctuation(msg.wm)
             return
         if not isinstance(msg, BatchTPU):
@@ -87,11 +104,38 @@ class TPUReplicaBase(BasicReplica):
         self.stats.device_batches_in += 1
         self._advance_wm(msg.wm)
         msg.wm = self.cur_wm
-        self.process_device_batch(msg)
+        t0 = time.perf_counter()
+        commit = self.prep_device_batch(msg)
+        prep_us = (time.perf_counter() - t0) * 1e6
+        if commit is not None:
+            self.dispatch.submit(commit, prep_us)
+        else:
+            self.stats.note_host_prep(prep_us)  # batch needed no commit
         self.stats.end_svc(msg.size)
+
+    def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
+        """Host-prep stage: return this batch's device-commit thunk (or
+        None when the batch needs no device work). Subclasses that
+        separate their host control plane override this; the default
+        keeps the whole legacy ``process_device_batch`` as the commit
+        stage — still correct (commits run in submission order and drain
+        at every ordering point), just without the prep overlap."""
+        return lambda: self.process_device_batch(batch)
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         raise NotImplementedError
+
+    def on_idle(self) -> bool:
+        """Worker idle tick: commit in-flight batches on a quiet stream
+        (Worker._process; same contract as the emitter FIFOs)."""
+        return self.dispatch.on_idle()
+
+    def terminate(self) -> None:
+        # EOS: in-flight batches commit before any flush/close logic —
+        # regardless of subclass flush_on_termination overrides
+        if not self.terminated:
+            self.dispatch.drain(forced=True)
+        super().terminate()
 
     def _emit_batch(self, batch: BatchTPU) -> None:
         self.stats.device_batches_out += 1
@@ -115,6 +159,25 @@ class TPUReplicaBase(BasicReplica):
         nb.stream_tag = batch.stream_tag
         if new_size > 0:
             self._emit_batch(nb)
+
+    def batch_keys_np(self, batch: BatchTPU):
+        """``(keys, keys_arr)`` with at most ONE conversion — the
+        host-prep stage's hot path (``key_column_to_list`` followed by
+        ``np.asarray`` boxes every key twice per batch). Int key columns
+        return the raw array for both forms: every ``KeySlotMap`` path
+        that registers keys from an int array goes through ``int()``, so
+        slot identity and the ktable fast path's ``isinstance(key, int)``
+        checks still see Python ints. Other dtypes keep the list form
+        (their consumers iterate Python keys)."""
+        keys = batch.host_keys
+        if keys is None and self.op.key_field is not None \
+                and self.op.key_field in batch.fields:
+            arr = key_column_np(batch, self.op.key_field)
+            if arr.dtype.kind in "iu":
+                return arr, arr
+        if keys is None:
+            keys = self.batch_keys(batch)
+        return keys, np.asarray(keys)
 
     # per-batch keys: host metadata when staged keyed, else the device key
     # column named by a string key extractor
@@ -331,7 +394,12 @@ class _KeyedStateScan:
                         for f, o in outs.items()}
             return out_rows, table2
 
-        return jax.jit(run)
+        # the state table is DONATED: the touched-row scatter updates it
+        # in place instead of copying the whole table every batch (the
+        # same double-buffer discipline as the FFAT forest — every call
+        # site reassigns self.table from the program output, so the
+        # consumed buffer is never reused)
+        return jax.jit(run, donate_argnums=(5,))
 
     # -- host side ---------------------------------------------------------
     def _ensure_table(self, n_keys_needed: int) -> None:
@@ -343,6 +411,10 @@ class _KeyedStateScan:
             self.table = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
                                    dtype=jnp.asarray(v).dtype), init)
+        if n_keys_needed > self.table_capacity:
+            # growth reads the CURRENT table: in-flight commits reassign
+            # it (donation), so they must land first
+            self.replica.dispatch.drain(forced=True)
         while n_keys_needed > self.table_capacity:
             self.table_capacity *= 2
             old = self.table
@@ -365,8 +437,7 @@ class _KeyedStateScan:
 
         n = batch.size
         cap = batch.capacity
-        keys = self.replica.batch_keys(batch)
-        keys_arr = np.asarray(keys)
+        keys, keys_arr = self.replica.batch_keys_np(batch)
         gslots = self._keymap.slots_of(keys, keys_arr, n)
         self._ensure_table(len(self.slot_of_key))
         if self.table_capacity <= 4 * max(1, n):
@@ -408,14 +479,22 @@ class StatefulMapTPUReplica(TPUReplicaBase):
         super().__init__(op, idx)
         self.engine = _KeyedStateScan(self, op.func, op.state_init, False)
 
-    def process_device_batch(self, batch: BatchTPU) -> None:
+    def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
+        # host prep: slot mapping + grid assembly (grid_meta drains the
+        # pipeline itself iff the state table must grow); the commit
+        # reads self.engine.table AT COMMIT TIME — earlier queued commits
+        # reassign it (donation)
         grid_idx, valid, touched, tmask, M, KB = self.engine.grid_meta(batch)
         prog = self.engine.program(M, KB)
-        outs, table2 = prog(batch.fields, grid_idx, valid, touched, tmask,
-                            self.engine.table)
-        self.stats.device_programs_run += 1
-        self.engine.table = table2
-        self._emit_batch(batch.with_fields(outs))
+
+        def commit() -> None:
+            outs, table2 = prog(batch.fields, grid_idx, valid, touched,
+                                tmask, self.engine.table)
+            self.stats.device_programs_run += 1
+            self.engine.table = table2
+            self._emit_batch(batch.with_fields(outs))
+
+        return commit
 
 
 class StatefulFilterTPUReplica(TPUReplicaBase):
@@ -426,14 +505,21 @@ class StatefulFilterTPUReplica(TPUReplicaBase):
         super().__init__(op, idx)
         self.engine = _KeyedStateScan(self, op.pred, op.state_init, True)
 
-    def process_device_batch(self, batch: BatchTPU) -> None:
+    def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
         grid_idx, valid, touched, tmask, M, KB = self.engine.grid_meta(batch)
         prog = self.engine.program(M, KB)
-        out, order, count, table2 = prog(batch.fields, grid_idx, valid,
-                                         touched, tmask, self.engine.table)
-        self.stats.device_programs_run += 1
-        self.engine.table = table2
-        self.emit_compacted(batch, out, order, count)
+
+        def commit() -> None:
+            out, order, count, table2 = prog(
+                batch.fields, grid_idx, valid, touched, tmask,
+                self.engine.table)
+            self.stats.device_programs_run += 1
+            self.engine.table = table2
+            # emit_compacted's int(count)/np.asarray(order) readbacks run
+            # here, depth batches after dispatch — no fresh-result stall
+            self.emit_compacted(batch, out, order, count)
+
+        return commit
 
 
 # ---------------------------------------------------------------------------
@@ -615,7 +701,7 @@ class ReduceTPUReplica(TPUReplicaBase):
 
         n = batch.size
         cap = batch.capacity
-        keys_arr = np.asarray(self.batch_keys(batch))
+        _, keys_arr = self.batch_keys_np(batch)
         if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
             order_n = np.argsort(keys_arr[:n], kind="stable")
             sk = keys_arr[:n][order_n]
@@ -633,20 +719,27 @@ class ReduceTPUReplica(TPUReplicaBase):
             slots_np, len(slot_of_key) + 1).astype(np.int32)
         return order, slots_np[order], slot_of_key
 
-    def process_device_batch(self, batch: BatchTPU) -> None:
+    def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
         import jax
 
+        # host prep: ONE key sort + slot metadata; the program call and
+        # the output-batch assembly are the deferred commit stage
         order_np, ssorted, slot_of_key = self._order_and_slots(batch)
-        out_fields = self._jitted(batch.fields, jax.device_put(order_np),
-                                  jax.device_put(ssorted))
-        self.stats.device_programs_run += 1
         n_out = len(slot_of_key)
         if n_out == 0:
-            return
+            return None
+        order_dev = jax.device_put(order_np)
+        ssorted_dev = jax.device_put(ssorted)
         out_keys = list(slot_of_key.keys())  # insertion order == slot order
         batch_ts = int(batch.ts_host[:batch.size].max()) if batch.size else 0
-        ts2 = np.full(batch.capacity, batch_ts, dtype=np.int64)
-        nb = BatchTPU(out_fields, ts2, n_out, batch.schema, batch.wm,
-                      out_keys)
-        nb.stream_tag = batch.stream_tag
-        self._emit_batch(nb)
+
+        def commit() -> None:
+            out_fields = self._jitted(batch.fields, order_dev, ssorted_dev)
+            self.stats.device_programs_run += 1
+            ts2 = np.full(batch.capacity, batch_ts, dtype=np.int64)
+            nb = BatchTPU(out_fields, ts2, n_out, batch.schema, batch.wm,
+                          out_keys)
+            nb.stream_tag = batch.stream_tag
+            self._emit_batch(nb)
+
+        return commit
