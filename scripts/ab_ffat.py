@@ -29,12 +29,12 @@ def main() -> None:
               "meaningful here", flush=True)
     for flag, label in (("0", label0), ("1", label1)):
         os.environ[env_var] = flag
-        chunks, _, progs = bench._run_config(
+        chunks, _, _, progs = bench._run_config(
             bench.N_KEYS, bench.WIN_PER_BATCH, 12, repeats=2)
         st = bench._chunk_stats(chunks)
         print(f"{label}: 64keys mean {st['mean']/1e6:.1f}M / best "
               f"{st['best']/1e6:.1f}M t/s ({progs} programs)", flush=True)
-        hchunks, _, _ = bench._run_config(
+        hchunks, _, _, _ = bench._run_config(
             bench.HC_KEYS, bench.HC_WIN_PER_BATCH, 6, repeats=2)
         hs = bench._chunk_stats(hchunks)
         print(f"{label}: 10k keys mean {hs['mean']/1e6:.1f}M t/s, "

@@ -85,7 +85,7 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
-    chunks, _p99, progs = bench._run_config(bench.N_KEYS, 64, 48,
+    chunks, _p50, _p99, progs = bench._run_config(bench.N_KEYS, 64, 48,
                                             lat_batches=0)
     st = bench._chunk_stats(chunks)
     print(f"FFAT 64keys isolated: {st['mean']/1e6:.1f}M t/s, "

@@ -36,6 +36,7 @@ class BasicBuilder:
         self._parallelism = 1
         self._output_batch_size = 0
         self._closing: Optional[Callable] = None
+        self._latency_sample: Optional[int] = None
 
     def with_name(self, name: str) -> "BasicBuilder":
         self._name = name
@@ -57,8 +58,21 @@ class BasicBuilder:
         self._closing = fn
         return self
 
+    def with_latency_tracing(self, rate=1) -> "BasicBuilder":
+        """Per-operator latency-tracing sample rate, overriding the
+        ``WF_LATENCY_SAMPLE`` env knob for this operator: ``1`` samples
+        every tuple, ``"1/64"`` (or ``0.015625``) every 64th, ``0``
+        disables. Sources stamp sampled tuples, sinks record end-to-end
+        latency, every replica records sampled service/dispatch
+        latencies into its histograms (monitoring/tracing.py)."""
+        from .monitoring.tracing import parse_sample_rate
+        self._latency_sample = parse_sample_rate(rate)
+        return self
+
     def _finish(self, op):
         op.closing_func = self._closing
+        if self._latency_sample is not None:
+            op.latency_sample = self._latency_sample
         return op
 
 

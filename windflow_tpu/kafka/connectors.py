@@ -429,7 +429,11 @@ class KafkaSourceReplica(BasicReplica):
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         if wm > self.cur_wm:
             self.cur_wm = wm
-        self.stats.inputs_received += 1
+        st = self.stats
+        st.inputs_received += 1
+        # sampled latency tracing, same mask gate as SourceReplica.ship
+        if not (st.inputs_received & (st.sample_every - 1)):
+            self.emitter.trace_ts = current_time_usecs()
         self.emitter.emit(payload, ts, self.cur_wm)
 
 
@@ -462,6 +466,8 @@ class KafkaSinkReplica(BasicReplica):
     def __init__(self, op, idx):
         super().__init__(op, idx)
         self._transport = make_transport(op.brokers)
+        # terminal operator: record end-to-end latency of traced tuples
+        self._e2e = self.stats.hist_e2e
 
     def process(self, payload, ts, wm, tag):
         out = (self.op.ser_func(payload, self.context) if self.op._riched

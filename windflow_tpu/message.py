@@ -34,7 +34,8 @@ class StreamMsg:
 
 
 class Single(StreamMsg):
-    __slots__ = ("payload", "id", "ts", "wm", "is_punct", "stream_tag")
+    __slots__ = ("payload", "id", "ts", "wm", "is_punct", "stream_tag",
+                 "trace_ts")
 
     def __init__(self, payload: Any, id: int = 0, ts: int = 0, wm: int = 0,
                  is_punct: bool = False, stream_tag: int = 0) -> None:
@@ -44,13 +45,18 @@ class Single(StreamMsg):
         self.wm = wm
         self.is_punct = is_punct
         self.stream_tag = stream_tag
+        # sampled latency-tracing origin stamp (current_time_usecs at the
+        # source; 0 = untraced — monitoring/tracing.py)
+        self.trace_ts = 0
 
     def min_watermark(self) -> int:
         return self.wm
 
     def copy_for_dest(self) -> "Single":
-        return Single(self.payload, self.id, self.ts, self.wm,
-                      self.is_punct, self.stream_tag)
+        s = Single(self.payload, self.id, self.ts, self.wm,
+                   self.is_punct, self.stream_tag)
+        s.trace_ts = self.trace_ts
+        return s
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         if self.is_punct:
@@ -67,7 +73,8 @@ def make_punctuation(wm: int, stream_tag: int = 0) -> Single:
 class Batch(StreamMsg):
     """Row-major CPU micro-batch. ``rows`` is a list of ``(payload, ts)``."""
 
-    __slots__ = ("rows", "wm", "is_punct", "stream_tag", "id")
+    __slots__ = ("rows", "wm", "is_punct", "stream_tag", "id",
+                 "trace_min", "trace_max")
 
     def __init__(self, rows: Optional[List[Tuple[Any, int]]] = None,
                  wm: int = 0, is_punct: bool = False, stream_tag: int = 0) -> None:
@@ -76,6 +83,9 @@ class Batch(StreamMsg):
         self.is_punct = is_punct
         self.stream_tag = stream_tag
         self.id = 0  # per-channel sequence number (DETERMINISTIC ordering)
+        # min/max origin stamps over traced constituents (0 = none traced)
+        self.trace_min = 0
+        self.trace_max = 0
 
     # -- construction ------------------------------------------------------
     def add_tuple(self, payload: Any, ts: int, wm: int) -> None:
@@ -84,6 +94,13 @@ class Batch(StreamMsg):
         if not self.rows or wm < self.wm:
             self.wm = wm
         self.rows.append((payload, ts))
+
+    def note_trace(self, t0: int) -> None:
+        """Fold one traced constituent's origin stamp into the batch."""
+        if self.trace_min == 0 or t0 < self.trace_min:
+            self.trace_min = t0
+        if t0 > self.trace_max:
+            self.trace_max = t0
 
     # -- protocol ----------------------------------------------------------
     def __len__(self) -> int:
@@ -97,7 +114,9 @@ class Batch(StreamMsg):
         return self.wm
 
     def copy_for_dest(self) -> "Batch":
-        return Batch(list(self.rows), self.wm, self.is_punct, self.stream_tag)
+        b = Batch(list(self.rows), self.wm, self.is_punct, self.stream_tag)
+        b.trace_min, b.trace_max = self.trace_min, self.trace_max
+        return b
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"<Batch n={len(self.rows)} wm={self.wm}>"

@@ -25,6 +25,17 @@ from typing import Any, Dict, Optional
 
 
 class MonitoringThread(threading.Thread):
+    """Streams diagram + 1 Hz reports to the dashboard with BOUNDED
+    reconnect/backoff: a dashboard absent at startup (or restarted
+    mid-run) still gets reports once it comes up — the seed behavior
+    (one ``create_connection`` then give up forever) silently lost the
+    whole run's telemetry to a startup race."""
+
+    # reconnect backoff: 0.5 s doubling to a 5 s cap; retries continue
+    # until the graph stops (each attempt is one cheap connect() probe)
+    _BACKOFF_MIN_S = 0.5
+    _BACKOFF_MAX_S = 5.0
+
     def __init__(self, graph, machine: Optional[str] = None,
                  port: Optional[int] = None, period_sec: float = 1.0) -> None:
         super().__init__(name=f"monitor:{graph.name}", daemon=True)
@@ -35,38 +46,201 @@ class MonitoringThread(threading.Thread):
         self.period = period_sec
         # NB: threading.Thread has a private _stop METHOD; don't shadow it
         self._stop_evt = threading.Event()
+        self.connects = 0  # successful connections (observability/tests)
 
     def stop(self) -> None:
         self._stop_evt.set()
 
-    def run(self) -> None:
+    def _connect(self) -> Optional[socket.socket]:
         try:
-            sock = socket.create_connection((self.machine, self.port),
+            return socket.create_connection((self.machine, self.port),
                                             timeout=2.0)
         except OSError:
-            return  # dashboard absent: tracing continues via local logs
-        try:
-            f = sock.makefile("w")
-            f.write(json.dumps({"type": "diagram", "graph": self.graph.name,
-                                "dot": self.graph.to_dot(),
-                                "svg": self.graph.to_svg()}) + "\n")
-            f.flush()
-            while not self._stop_evt.wait(self.period):
-                f.write(json.dumps({"type": "report",
+            return None
+
+    def run(self) -> None:
+        backoff = self._BACKOFF_MIN_S
+        while not self._stop_evt.is_set():
+            sock = self._connect()
+            if sock is None:
+                # dashboard absent: back off and retry until stopped
+                if self._stop_evt.wait(backoff):
+                    return
+                backoff = min(backoff * 2, self._BACKOFF_MAX_S)
+                continue
+            backoff = self._BACKOFF_MIN_S
+            self.connects += 1
+            try:
+                f = sock.makefile("w")
+                # (re)send the diagram on every connection: a freshly
+                # started dashboard has no prior state
+                f.write(json.dumps({"type": "diagram",
                                     "graph": self.graph.name,
+                                    "dot": self.graph.to_dot(),
+                                    "svg": self.graph.to_svg()}) + "\n")
+                f.flush()
+                while not self._stop_evt.wait(self.period):
+                    f.write(json.dumps(
+                        {"type": "report", "graph": self.graph.name,
+                         "stats": self.graph.get_stats()}) + "\n")
+                    f.flush()
+                f.write(json.dumps({"type": "report",
+                                    "graph": self.graph.name, "final": True,
                                     "stats": self.graph.get_stats()}) + "\n")
                 f.flush()
-            f.write(json.dumps({"type": "report", "graph": self.graph.name,
-                                "final": True,
-                                "stats": self.graph.get_stats()}) + "\n")
-            f.flush()
-        except OSError:
-            pass
-        finally:
-            try:
-                sock.close()
+                return  # clean final report delivered
             except OSError:
-                pass
+                pass  # connection lost mid-run: reconnect loop resumes
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+
+def _prom_escape(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+# (family, TYPE, HELP, stats-dict field, scale) — scalar per-replica series
+_PROM_SCALARS = (
+    ("windflow_inputs_received_total", "counter",
+     "Tuples received by the replica", "Inputs_received", 1),
+    ("windflow_outputs_sent_total", "counter",
+     "Tuples sent downstream", "Outputs_sent", 1),
+    ("windflow_inputs_ignored_total", "counter",
+     "Tuples dropped/filtered by the replica", "Inputs_ignored", 1),
+    ("windflow_punctuations_received_total", "counter",
+     "Watermark punctuations received", "Punctuations_received", 1),
+    ("windflow_throughput_tuples_per_second", "gauge",
+     "Replica input throughput since start", "Throughput_tuples_sec", 1),
+    ("windflow_service_time_ewma_usec", "gauge",
+     "EWMA per-tuple service time (microseconds)", "Service_time_usec", 1),
+    ("windflow_device_programs_run_total", "counter",
+     "XLA programs dispatched by the replica", "Device_programs_run", 1),
+    ("windflow_device_bytes_h2d_total", "counter",
+     "Bytes staged host-to-device", "Device_bytes_H2D", 1),
+    ("windflow_device_bytes_d2h_total", "counter",
+     "Bytes fetched device-to-host", "Device_bytes_D2H", 1),
+    ("windflow_dispatch_batches_total", "counter",
+     "Batches through the device-ahead dispatch pipeline",
+     "Dispatch_batches", 1),
+    ("windflow_dispatch_stalls_total", "counter",
+     "Forced ordering-point drains with commits in flight",
+     "Dispatch_readback_stalls", 1),
+    ("windflow_queue_occupancy", "gauge",
+     "Input channel occupancy (messages)", "Queue_len", 1),
+    ("windflow_queue_capacity", "gauge",
+     "Input channel capacity (messages)", "Queue_capacity", 1),
+    ("windflow_queue_depth_max", "gauge",
+     "Input channel occupancy high-water mark", "Queue_depth_max", 1),
+    ("windflow_queue_blocked_put_seconds_total", "counter",
+     "Producer time blocked on this full input channel (backpressure)",
+     "Queue_blocked_put_usec", 1e-6),
+    ("windflow_queue_blocked_get_seconds_total", "counter",
+     "Consumer time blocked on this empty input channel (starvation)",
+     "Queue_blocked_get_usec", 1e-6),
+    ("windflow_emit_fifo_depth_max", "gauge",
+     "Emitter-side pipelined FIFO high-water mark",
+     "Queue_emit_fifo_depth_max", 1),
+    ("windflow_worker_idle_ticks_total", "counter",
+     "Worker idle-drain ticks", "Worker_idle_ticks", 1),
+)
+
+# per-operator merged histograms: (family, HELP, stats hist field)
+_PROM_HISTS = (
+    ("windflow_service_latency_usec", "Sampled per-tuple service time",
+     "Latency_service_hist"),
+    ("windflow_dispatch_prep_latency_usec",
+     "Host-prep stage latency per device batch", "Latency_prep_hist"),
+    ("windflow_dispatch_commit_latency_usec",
+     "Device-commit stage latency per device batch", "Latency_commit_hist"),
+    ("windflow_e2e_latency_usec",
+     "Sampled end-to-end tuple latency recorded at sinks",
+     "Latency_e2e_hist"),
+)
+
+
+def prometheus_text(snapshot: Dict[str, Any]) -> str:
+    """Render the latest reports as Prometheus text exposition format
+    (version 0.0.4). Scalars are per-replica series; latency histograms
+    are merged per operator (the replica histograms are mergeable by
+    construction — monitoring/histogram.py)."""
+    from .histogram import LatencyHistogram
+
+    reports = snapshot.get("reports", {})
+    lines = []
+    # scalar families
+    for fam, typ, help_, field, scale in _PROM_SCALARS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            g = _prom_escape(graph)
+            for op in st.get("Operators", []) or []:
+                o = _prom_escape(op.get("name", "?"))
+                for rep in op.get("replicas", []) or []:
+                    v = rep.get(field)
+                    if not isinstance(v, (int, float)):
+                        continue
+                    body.append(
+                        f'{fam}{{graph="{g}",operator="{o}",'
+                        f'replica="{int(rep.get("Replica_id", 0))}"}} '
+                        f'{v * scale:g}')
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
+    # graph-level counters
+    drop_body = []
+    for graph, st in reports.items():
+        if isinstance(st, dict) and isinstance(st.get("Dropped_tuples"),
+                                               (int, float)):
+            drop_body.append(
+                f'windflow_dropped_tuples_total'
+                f'{{graph="{_prom_escape(graph)}"}} '
+                f'{st["Dropped_tuples"]:g}')
+    if drop_body:
+        lines.append("# HELP windflow_dropped_tuples_total Tuples dropped "
+                     "by reordering collectors")
+        lines.append("# TYPE windflow_dropped_tuples_total counter")
+        lines.extend(drop_body)
+    # merged per-operator histograms
+    for fam, help_, field in _PROM_HISTS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            g = _prom_escape(graph)
+            for op in st.get("Operators", []) or []:
+                parts = [LatencyHistogram.from_sparse(rep.get(field))
+                         for rep in op.get("replicas", []) or []
+                         if isinstance(rep, dict) and rep.get(field)]
+                if not parts:
+                    continue
+                h = LatencyHistogram.merged(parts)
+                if h.count == 0:
+                    continue
+                o = _prom_escape(op.get("name", "?"))
+                base = f'graph="{g}",operator="{o}"'
+                for le, cum in h.cumulative_buckets():
+                    if le == float("inf"):
+                        continue
+                    body.append(f'{fam}_bucket{{{base},le="{le:g}"}} {cum}')
+                body.append(f'{fam}_bucket{{{base},le="+Inf"}} {h.count}')
+                body.append(f'{fam}_sum{{{base}}} {h.sum_us:g}')
+                body.append(f'{fam}_count{{{base}}} {h.count}')
+        if body:
+            lines.append(f"# HELP {fam} {help_} (microseconds)")
+            lines.append(f"# TYPE {fam} histogram")
+            lines.extend(body)
+    lines.append(f"# HELP windflow_reports_total Monitoring reports "
+                 f"received by this server")
+    lines.append("# TYPE windflow_reports_total counter")
+    lines.append(f'windflow_reports_total {snapshot.get("n_reports", 0)}')
+    return "\n".join(lines) + "\n"
 
 
 def _safe_diagram(svg, dot: str) -> str:
@@ -167,6 +341,8 @@ class MonitoringServer:
                         drill-down — the reference's React app equivalent)
         GET /json    -> full snapshot (sanitized SVGs)
         GET /graph/<name> -> one graph's latest stats
+        GET /metrics -> Prometheus text exposition (counters, queue
+                        gauges, per-operator latency histograms)
         GET /plain   -> server-rendered static view (no JS)"""
         import http.server
 
@@ -198,6 +374,9 @@ class MonitoringServer:
                 if self.path == "/":
                     from .webclient import CLIENT_HTML
                     self._send(200, CLIENT_HTML, "text/html")
+                elif self.path == "/metrics":
+                    self._send(200, prometheus_text(snap),
+                               "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/json":
                     self._send(200, json.dumps(snap))
                 elif self.path.startswith("/graph/"):

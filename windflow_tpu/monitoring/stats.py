@@ -5,12 +5,19 @@ EWMA (``wf/basic_operator.hpp:144-158``), and device-plane traffic (batches
 staged to/from the TPU, bytes moved — the analog of the reference's kernels
 launched / bytes H2D/D2H). Serialized to JSON by the PipeGraph at wait_end
 (``wf/pipegraph.hpp:464-522``).
+
+On top of the reference's counters this record carries the latency-tracing
+plane (monitoring/tracing.py): per-replica log2 histograms of service time,
+dispatch prep/commit latency and (sinks) end-to-end latency — allocated only
+when sampling is enabled, so the default hot path never touches them — plus
+queue-occupancy/backpressure gauges read from the replica's input channel
+and the emitter-side FIFOs.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 _EWMA_ALPHA = 0.1
 
@@ -28,9 +35,19 @@ class StatsRecord:
         "dispatch_host_prep_total_us", "dispatch_commit_total_us",
         "dispatch_batches", "dispatch_stalls", "dispatch_depth_max",
         "is_terminated", "_last_svc_start",
+        # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
+        # (a genuine ~0 first sample would re-seed forever, biasing early
+        # readings); explicit flags instead
+        "_svc_seeded", "_prep_seeded", "_commit_seeded",
+        # latency-tracing plane (None / 0 when sampling is off)
+        "sample_every", "_svc_rec",
+        "hist_service", "hist_prep", "hist_commit", "hist_e2e",
+        # queue / backpressure plane
+        "input_channel", "pipe_depth_max", "worker_idle_ticks",
     )
 
-    def __init__(self, op_name: str = "", replica_idx: int = 0) -> None:
+    def __init__(self, op_name: str = "", replica_idx: int = 0,
+                 sample_every: int = 0) -> None:
         self.op_name = op_name
         self.replica_idx = replica_idx
         self.start_time = time.monotonic()
@@ -62,6 +79,32 @@ class StatsRecord:
         self.dispatch_depth_max = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
+        self._svc_seeded = False
+        self._prep_seeded = False
+        self._commit_seeded = False
+        # -- latency tracing (monitoring/histogram.py) ----------------------
+        self.sample_every = max(0, int(sample_every))
+        # service-histogram request flag: the replica's traced-message
+        # branch sets it; the next end_svc consumes it. Keying service
+        # sampling off TRACED messages keeps the end_svc hot path at one
+        # bool check regardless of sampling rate (and records a cohort
+        # consistent with the e2e samples).
+        self._svc_rec = False
+        if self.sample_every > 0:
+            from .histogram import LatencyHistogram
+            self.hist_service: Optional[Any] = LatencyHistogram()
+            self.hist_prep: Optional[Any] = LatencyHistogram()
+            self.hist_commit: Optional[Any] = LatencyHistogram()
+            self.hist_e2e: Optional[Any] = LatencyHistogram()
+        else:
+            self.hist_service = None
+            self.hist_prep = None
+            self.hist_commit = None
+            self.hist_e2e = None
+        # -- queue / backpressure gauges ------------------------------------
+        self.input_channel = None  # wired by PipeGraph._make_workers
+        self.pipe_depth_max = 0  # emitter-side FIFO high-water mark
+        self.worker_idle_ticks = 0
 
     # -- service-time recording (wf/basic_operator.hpp:134-158) -------------
     def start_svc(self) -> None:
@@ -70,29 +113,40 @@ class StatsRecord:
     def end_svc(self, n_tuples: int = 1) -> None:
         dt_us = (time.perf_counter() - self._last_svc_start) * 1e6
         per_tuple = dt_us / max(1, n_tuples)
-        if self.service_time_us == 0.0:
+        if not self._svc_seeded:
+            self._svc_seeded = True
             self.service_time_us = per_tuple
         else:
             self.service_time_us += _EWMA_ALPHA * (per_tuple - self.service_time_us)
         self.eff_service_time_us = self.service_time_us
+        if self._svc_rec:
+            self._svc_rec = False
+            if self.hist_service is not None:
+                self.hist_service.record(per_tuple)
 
     # -- dispatch-pipeline stages (runtime/dispatch.py) ----------------------
     def note_host_prep(self, us: float) -> None:
         self.dispatch_batches += 1
         self.dispatch_host_prep_total_us += us
-        if self.dispatch_host_prep_us == 0.0:
+        if not self._prep_seeded:
+            self._prep_seeded = True
             self.dispatch_host_prep_us = us
         else:
             self.dispatch_host_prep_us += _EWMA_ALPHA * (
                 us - self.dispatch_host_prep_us)
+        if self.hist_prep is not None:
+            self.hist_prep.record(us)
 
     def note_dispatch_commit(self, us: float) -> None:
         self.dispatch_commit_total_us += us
-        if self.dispatch_commit_us == 0.0:
+        if not self._commit_seeded:
+            self._commit_seeded = True
             self.dispatch_commit_us = us
         else:
             self.dispatch_commit_us += _EWMA_ALPHA * (
                 us - self.dispatch_commit_us)
+        if self.hist_commit is not None:
+            self.hist_commit.record(us)
 
     def note_dispatch_depth(self, depth: int) -> None:
         if depth > self.dispatch_depth_max:
@@ -101,9 +155,20 @@ class StatsRecord:
     def note_dispatch_stall(self) -> None:
         self.dispatch_stalls += 1
 
+    # -- latency tracing -----------------------------------------------------
+    def note_e2e(self, us: float) -> None:
+        """End-to-end latency of one traced tuple (sink side)."""
+        if self.hist_e2e is not None:
+            self.hist_e2e.record(us)
+
+    def note_pipe_depth(self, depth: int) -> None:
+        """Emitter-side FIFO occupancy high-water mark (_D2HPipeline)."""
+        if depth > self.pipe_depth_max:
+            self.pipe_depth_max = depth
+
     def to_dict(self) -> Dict[str, Any]:
         elapsed = max(time.monotonic() - self.start_time, 1e-9)
-        return {
+        d = {
             "Operator_name": self.op_name,
             "Replica_id": self.replica_idx,
             "Inputs_received": self.inputs_received,
@@ -134,3 +199,37 @@ class StatsRecord:
             "Dispatch_queue_depth_max": self.dispatch_depth_max,
             "isTerminated": self.is_terminated,
         }
+        # -- queue / backpressure plane (0s for sources and fused chains) ---
+        ch = self.input_channel
+        d["Queue_len"] = len(ch) if ch is not None else 0
+        d["Queue_capacity"] = getattr(ch, "capacity", 0) if ch is not None \
+            else 0
+        d["Queue_depth_max"] = getattr(ch, "depth_max", 0) if ch is not None \
+            else 0
+        d["Queue_blocked_put_usec"] = round(
+            getattr(ch, "blocked_put_ns", 0) / 1e3, 1) if ch is not None \
+            else 0.0
+        d["Queue_blocked_get_usec"] = round(
+            getattr(ch, "blocked_get_ns", 0) / 1e3, 1) if ch is not None \
+            else 0.0
+        d["Queue_puts_blocked"] = getattr(ch, "puts_blocked", 0) \
+            if ch is not None else 0
+        d["Queue_emit_fifo_depth_max"] = self.pipe_depth_max
+        d["Worker_idle_ticks"] = self.worker_idle_ticks
+        # -- latency-tracing plane ------------------------------------------
+        d["Latency_sample_every"] = self.sample_every
+        for label, h in (("service", self.hist_service),
+                         ("prep", self.hist_prep),
+                         ("commit", self.hist_commit),
+                         ("e2e", self.hist_e2e)):
+            on = h is not None
+            d[f"Latency_{label}_p50_usec"] = round(h.p50, 1) if on else 0.0
+            d[f"Latency_{label}_p90_usec"] = round(h.p90, 1) if on else 0.0
+            d[f"Latency_{label}_p99_usec"] = round(h.p99, 1) if on else 0.0
+            d[f"Latency_{label}_max_usec"] = round(h.max_us, 1) if on else 0.0
+            d[f"Latency_{label}_samples"] = h.count if on else 0
+            if on and h.count:
+                # sparse bucket transport: /metrics renders real histogram
+                # series and per-operator merges from these
+                d[f"Latency_{label}_hist"] = h.to_sparse()
+        return d

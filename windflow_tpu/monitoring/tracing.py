@@ -1,0 +1,112 @@
+"""Sampled per-tuple latency tracing — configuration and span helpers.
+
+The tracing plane has three parts (none of which replaces the EWMAs —
+those stay for dashboard parity):
+
+- SOURCES stamp a sampled subset of tuples with a wall-clock origin
+  (``current_time_usecs``, monotonic and process-wide comparable). The
+  stamp rides ``Single.trace_ts``; CPU batches carry ``trace_min`` /
+  ``trace_max`` over their traced constituents and the TPU staging path
+  propagates the same pair through ``BatchTPU`` — device batches never
+  materialize per-tuple stamps.
+- SINKS record end-to-end latency (now - origin) into their replica's
+  ``LatencyHistogram``; every replica additionally records sampled
+  service time and (device plane) dispatch prep/commit latency.
+- Device-plane stages are wrapped in ``jax.profiler.TraceAnnotation``
+  spans (``wf:prep:<op>`` / ``wf:commit:<op>``) so a device trace
+  captured with ``jax.profiler.trace`` lines up with these host stats.
+
+Sampling knob: ``WF_LATENCY_SAMPLE`` globally, or per operator via the
+builders' ``with_latency_tracing(rate)``. A rate is ``1`` (every
+tuple), a fraction ``"1/64"``, a float ``0.01``, or ``0`` (off — the
+default: no clock reads, no histogram work on the hot path). Internally
+a rate becomes a sampling INTERVAL (record every Nth), so sampling is
+deterministic and divides exactly under test.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import nullcontext
+from typing import Optional
+
+__all__ = ["parse_sample_rate", "env_sample_every", "resolve_sample_every",
+           "device_span"]
+
+
+def parse_sample_rate(value) -> int:
+    """Sampling rate -> interval N (record every Nth sample; 0 = off).
+
+    Accepts 1 / "1" (every tuple), "1/64" (every 64th), a float in
+    (0, 1], or 0/""/None (off). Malformed values fall back to off — a
+    bad knob must not take down the graph. Intervals round UP to a
+    power of two: the source's per-tuple sampling gate is then a single
+    integer AND against ``interval - 1`` — the same cost whether
+    sampling is on or off, so enabling 1/64 tracing costs only the
+    sampled work itself (microbench --latency measures this)."""
+    if value is None:
+        return 0
+    if isinstance(value, str):
+        value = value.strip()
+        if not value:
+            return 0
+        if "/" in value:
+            try:
+                num, den = value.split("/", 1)
+                rate = float(num) / float(den)
+            except (ValueError, ZeroDivisionError):
+                return 0
+        else:
+            try:
+                rate = float(value)
+            except ValueError:
+                return 0
+    else:
+        try:
+            rate = float(value)
+        except (TypeError, ValueError):
+            return 0
+    if rate <= 0:
+        return 0
+    if rate >= 1:
+        return 1
+    n = max(1, round(1.0 / rate))
+    return 1 << (n - 1).bit_length()  # next power of two >= n
+
+
+def env_sample_every() -> int:
+    """The global sampling interval from ``WF_LATENCY_SAMPLE`` (0=off)."""
+    return parse_sample_rate(os.environ.get("WF_LATENCY_SAMPLE"))
+
+
+def resolve_sample_every(op) -> int:
+    """Per-operator interval: the builder knob wins over the env. The
+    result is always 0 or a power of two (the mask-gate contract)."""
+    s = getattr(op, "latency_sample", None)
+    if s is None:
+        return env_sample_every()
+    s = max(0, int(s))
+    if s & (s - 1):  # direct op.latency_sample writes may skip the parse
+        s = 1 << (s - 1).bit_length()
+    return s
+
+
+_TRACE_ANNOTATION = None  # resolved lazily; nullcontext when jax absent
+
+
+def device_span(name: str):
+    """A ``jax.profiler.TraceAnnotation`` context manager (host TraceMe
+    span visible in device profiles), or a no-op when jax is absent —
+    the CPU plane must not pay a jax import for observability."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except Exception:  # pragma: no cover - no jax in the venv
+            _TRACE_ANNOTATION = _null_span
+    return _TRACE_ANNOTATION(name)
+
+
+def _null_span(name: str):  # pragma: no cover - no jax in the venv
+    return nullcontext()

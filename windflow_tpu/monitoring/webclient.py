@@ -10,6 +10,9 @@ dependency-free client served by ``MonitoringServer.serve_http``: it polls
   time, device programs, staging pool hits) that update in place,
 - a canvas sparkline of each graph's total throughput history (kept
   client-side, 120 samples),
+- a second sparkline of the worst sink-side p99 end-to-end latency
+  (populated when latency tracing is sampling — WF_LATENCY_SAMPLE /
+  with_latency_tracing), plus svc/e2e p99 latency columns,
 - the dataflow SVG diagram (server-sanitized),
 - per-replica drill-down on click.
 """
@@ -43,6 +46,9 @@ CLIENT_HTML = r"""<!DOCTYPE html>
 <div id="badges"></div>
 <canvas id="spark" width="720" height="80"></canvas>
 <div class="muted">total tuples/s (last 120 s)</div>
+<canvas id="sparklat" width="720" height="60"></canvas>
+<div class="muted">worst p99 end-to-end latency µs (sampled tracing;
+flat at 0 when sampling is off)</div>
 <div id="ops"></div>
 <details open id="diagram"><summary>dataflow graph</summary></details>
 <script>
@@ -50,6 +56,7 @@ CLIENT_HTML = r"""<!DOCTYPE html>
 let current = null;            // selected graph
 let graphList = [], opNames = [];  // index -> name (XSS-safe handlers)
 const hist = {};               // graph -> [throughput samples]
+const lhist = {};              // graph -> [p99 e2e latency samples]
 const open = new Set();        // operator names with replica drill-down
 function fmt(n){ return (n===undefined||n===null)?"":
   Number(n).toLocaleString("en-US",{maximumFractionDigits:1}); }
@@ -75,17 +82,22 @@ function render(snap){
     `<span class=badge>threads ${st.Threads|0}</span>`+
     `<span class="badge ${st.Dropped_tuples? 'warn':''}">dropped `+
     `${fmt(st.Dropped_tuples)}</span>`;
-  let total = 0, rows = [];
+  let total = 0, worstP99 = 0, rows = [];
   opNames = (st.Operators||[]).map(o=>o.name);
   (st.Operators||[]).forEach((o, oi) => {
     const r = o.replicas, s = (k)=>r.reduce((a,x)=>a+(x[k]||0),0);
+    const m = (k)=>Math.max(...r.map(x=>x[k]||0));
     const tput = s("Throughput_tuples_sec"); total += tput;
+    worstP99 = Math.max(worstP99, m("Latency_e2e_p99_usec"));
     rows.push(`<tr onclick="tog(${oi})"><td class=l>${esc(o.name)}</td>`+
       `<td class=l>${esc(o.kind)}</td><td>${o.parallelism|0}</td>`+
       `<td>${fmt(s("Inputs_received"))}</td>`+
       `<td>${fmt(s("Outputs_sent"))}</td>`+
       `<td>${fmt(s("Inputs_ignored"))}</td><td>${fmt(tput)}</td>`+
-      `<td>${fmt(Math.max(...r.map(x=>x.Service_time_usec||0)))}</td>`+
+      `<td>${fmt(m("Service_time_usec"))}</td>`+
+      `<td>${fmt(m("Latency_service_p99_usec"))}</td>`+
+      `<td>${fmt(m("Latency_e2e_p99_usec"))}</td>`+
+      `<td>${fmt(m("Queue_len"))}/${fmt(m("Queue_depth_max"))}</td>`+
       `<td>${fmt(s("Device_programs_run"))}</td>`+
       `<td>${fmt(s("Staging_pool_hits"))}</td></tr>`);
     if (open.has(o.name))
@@ -96,35 +108,44 @@ function render(snap){
           `<td>${fmt(x.Outputs_sent)}</td><td>${fmt(x.Inputs_ignored)}</td>`+
           `<td>${fmt(x.Throughput_tuples_sec)}</td>`+
           `<td>${fmt(x.Service_time_usec)}</td>`+
+          `<td>${fmt(x.Latency_service_p99_usec)}</td>`+
+          `<td>${fmt(x.Latency_e2e_p99_usec)}</td>`+
+          `<td>${fmt(x.Queue_len)}/${fmt(x.Queue_depth_max)}</td>`+
           `<td>${fmt(x.Device_programs_run)}</td>`+
           `<td>${fmt(x.Staging_pool_hits)}</td></tr>`);
   });
   el("ops").innerHTML =
     `<table><tr><th class=l>operator</th><th class=l>kind</th><th>par</th>`+
     `<th>in</th><th>out</th><th>ignored</th><th>tuples/s</th>`+
-    `<th>svc µs</th><th>device progs</th><th>pool hits</th></tr>`+
+    `<th>svc µs</th><th>svc p99</th><th>e2e p99</th><th>queue</th>`+
+    `<th>device progs</th><th>pool hits</th></tr>`+
     rows.join("")+`</table>`+
-    `<div class=muted>click an operator row for per-replica detail</div>`;
+    `<div class=muted>click an operator row for per-replica detail; `+
+    `queue = occupancy/high-water of the operator's input channel</div>`;
   (hist[current] = hist[current]||[]).push(total);
   if (hist[current].length > 120) hist[current].shift();
   spark(hist[current]);
+  (lhist[current] = lhist[current]||[]).push(worstP99);
+  if (lhist[current].length > 120) lhist[current].shift();
+  sparkLine("sparklat", lhist[current], "#b0452b", "µs");
   const svg = (snap.svgs||{})[current];  // server-sanitized
   el("diagram").innerHTML = "<summary>dataflow graph</summary>"+
     (svg || "<pre>"+esc(snap.diagrams[current]||"")+"</pre>");
 }
-function spark(h){
-  const c = el("spark"), ctx = c.getContext("2d");
+function spark(h){ sparkLine("spark", h, "#2b6cb0", " t/s"); }
+function sparkLine(id, h, color, unit){
+  const c = el(id), ctx = c.getContext("2d");
   ctx.clearRect(0,0,c.width,c.height);
   if (!h.length) return;
   const max = Math.max(...h, 1);
-  ctx.beginPath(); ctx.strokeStyle = "#2b6cb0"; ctx.lineWidth = 1.6;
+  ctx.beginPath(); ctx.strokeStyle = color; ctx.lineWidth = 1.6;
   h.forEach((v,i)=>{
     const x = i*(c.width/120), y = c.height-4-(v/max)*(c.height-12);
     i? ctx.lineTo(x,y) : ctx.moveTo(x,y);
   });
   ctx.stroke();
   ctx.fillStyle="#555"; ctx.font="10px monospace";
-  ctx.fillText(fmt(max)+" t/s", 4, 10);
+  ctx.fillText(fmt(max)+unit, 4, 10);
 }
 function pick(i){ current = graphList[i]; }
 function tog(i){ const n = opNames[i];

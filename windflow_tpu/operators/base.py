@@ -15,11 +15,12 @@ import inspect
 from typing import Any, Callable, List, Optional
 
 from ..basic import (ExecutionMode, OpType, RoutingMode, TimePolicy,
-                     WindFlowError, as_key_fn, key_field_name,
-                     key_fields_names)
+                     WindFlowError, as_key_fn, current_time_usecs,
+                     key_field_name, key_fields_names)
 from ..context import RuntimeContext
 from ..message import Batch, Single
 from ..monitoring.stats import StatsRecord
+from ..monitoring.tracing import resolve_sample_every
 from ..runtime.emitters import BasicEmitter
 
 
@@ -69,6 +70,9 @@ class BasicOperator:
         self.replicas: List["BasicReplica"] = []
         self.execution_mode = ExecutionMode.DEFAULT
         self.time_policy = TimePolicy.INGRESS_TIME
+        # latency-tracing sample interval override (with_latency_tracing);
+        # None falls back to WF_LATENCY_SAMPLE (monitoring/tracing.py)
+        self.latency_sample: Optional[int] = None
         self._used = False  # operators are copied into the pipe; guard reuse
 
     # hooks -----------------------------------------------------------------
@@ -100,11 +104,16 @@ class BasicReplica:
         self.op = op
         self.idx = idx
         self.context = RuntimeContext(op.parallelism, idx)
-        self.stats = StatsRecord(op.name, idx)
+        self.stats = StatsRecord(op.name, idx,
+                                 sample_every=resolve_sample_every(op))
         self.emitter: Optional[BasicEmitter] = None
         self.copy_on_write = False  # set when fed by a broadcast emitter
         self.terminated = False
         self.cur_wm = 0
+        # end-to-end recording hook: SINK replicas bind this to their
+        # stats histogram when sampling is on; None keeps the per-message
+        # tracing check to one attribute load
+        self._e2e = None
 
     # -- wiring --------------------------------------------------------------
     def set_emitter(self, emitter: BasicEmitter) -> None:
@@ -124,14 +133,41 @@ class BasicReplica:
             self.stats.inputs_received += n
             self._advance_wm(msg.wm)
             tag = msg.stream_tag
+            t0 = msg.trace_min
+            if t0:  # traced batch: forward the stamp / record at sinks
+                self.stats._svc_rec = True
+                if self._e2e is not None:
+                    now = current_time_usecs()
+                    self._e2e.record(now - msg.trace_max)
+                    if msg.trace_max != t0:
+                        self._e2e.record(now - t0)
+                em = self.emitter
+                if em is not None:
+                    em.trace_ts = t0
             for payload, ts in msg.rows:
                 self.context._set_meta(ts, self.cur_wm)
                 self.process(payload, ts, self.cur_wm, tag)
+            if t0:
+                em = self.emitter
+                if em is not None:
+                    em.trace_ts = 0
         else:
             self.stats.inputs_received += 1
             self._advance_wm(msg.wm)
+            t0 = msg.trace_ts
+            if t0:  # traced tuple: forward the stamp / record at sinks
+                self.stats._svc_rec = True
+                if self._e2e is not None:
+                    self._e2e.record(current_time_usecs() - t0)
+                em = self.emitter
+                if em is not None:
+                    em.trace_ts = t0
             self.context._set_meta(msg.ts, self.cur_wm)
             self.process(msg.payload, msg.ts, self.cur_wm, msg.stream_tag)
+            if t0:
+                em = self.emitter
+                if em is not None:
+                    em.trace_ts = 0  # a dropped tuple must not stamp later ones
         self.stats.end_svc(n)
 
     def _advance_wm(self, wm: int) -> None:

@@ -194,6 +194,12 @@ class Sink(BasicOperator):
 
 
 class SinkReplica(BasicReplica):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        # sinks record end-to-end latency of traced tuples (None when
+        # sampling is off — the generic handle_msg hook stays dormant)
+        self._e2e = self.stats.hist_e2e
+
     def process(self, payload, ts, wm, tag):
         if self.op._riched:
             self.op.func(payload, self.context)
@@ -211,6 +217,10 @@ class SinkReplica(BasicReplica):
 class ColumnarSinkReplica(BasicReplica):
     """Consumes whole device batches as host COLUMN dicts — one functor
     call per batch, no per-row Python objects on the exit path."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self._e2e = self.stats.hist_e2e
 
     def handle_msg(self, ch: int, msg: Any) -> None:
         self.stats.start_svc()
@@ -231,6 +241,14 @@ class ColumnarSinkReplica(BasicReplica):
             n = msg.size
             self.stats.inputs_received += n
             self._advance_wm(msg.wm)
+            if self.stats.sample_every:  # per batch, not per tuple
+                self.stats._svc_rec = True
+            if self._e2e is not None and msg.trace_min:
+                from ..basic import current_time_usecs
+                now = current_time_usecs()
+                self._e2e.record(now - msg.trace_max)
+                if msg.trace_max != msg.trace_min:
+                    self._e2e.record(now - msg.trace_min)
             cols = {name: np.asarray(col)[:n]
                     for name, col in msg.fields.items()}
             ts = msg.ts_host[:n]

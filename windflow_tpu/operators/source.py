@@ -105,6 +105,16 @@ class Source(BasicOperator):
 
 
 class SourceReplica(BasicReplica):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        # sampled latency tracing (monitoring/tracing.py): every Nth
+        # shipped tuple carries a wall-clock origin stamp. The gate is
+        # a single integer AND against this mask — sample_every is a
+        # power of two, and a mask of -1 (sampling off) can never make
+        # ``inputs_received & mask`` zero, so the hot path costs the
+        # same with tracing off or sampling 1/64
+        self._trace_mask = self.stats.sample_every - 1
+
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Source has no input")
 
@@ -120,11 +130,18 @@ class SourceReplica(BasicReplica):
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         if wm > self.cur_wm:
             self.cur_wm = wm
-        self.stats.inputs_received += 1
+        st = self.stats
+        st.inputs_received += 1
+        if not (st.inputs_received & self._trace_mask):
+            self.emitter.trace_ts = current_time_usecs()
         self.emitter.emit(payload, ts, self.cur_wm)
 
     def ship_columns(self, cols, ts_arr, wm: int) -> None:
         if wm > self.cur_wm:
             self.cur_wm = wm
         self.stats.inputs_received += len(ts_arr)
+        if self.stats.sample_every:
+            # columnar pushes sample at push granularity (one stamp per
+            # call): per-row stamping would defeat the no-Python fast path
+            self.emitter.trace_ts = current_time_usecs()
         self.emitter.emit_columns(cols, ts_arr, self.cur_wm)

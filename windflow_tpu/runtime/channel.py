@@ -30,7 +30,9 @@ class Channel:
     Bounded => backpressure, like FastFlow's FF_BOUNDED_BUFFER mode.
     """
 
-    __slots__ = ("_q", "_lock", "_not_empty", "_not_full", "capacity", "n_inputs")
+    __slots__ = ("_q", "_lock", "_not_empty", "_not_full", "capacity",
+                 "n_inputs", "depth_max", "puts_blocked", "blocked_put_ns",
+                 "blocked_get_ns")
 
     def __init__(self, capacity: int = DEFAULT_BUFFER_CAPACITY) -> None:
         self._q: deque = deque()
@@ -39,6 +41,15 @@ class Channel:
         self._not_full = threading.Condition(self._lock)
         self.capacity = capacity
         self.n_inputs = 0  # number of producer edges; assigned at wiring
+        # backpressure / occupancy instrumentation (monitoring plane):
+        # producers blocked on a full queue (this stage IS the bottleneck)
+        # vs the consumer blocked on an empty one (it is starved). Clocks
+        # are read only on the blocked paths — the uncontended hot path
+        # pays one compare for the high-water mark.
+        self.depth_max = 0
+        self.puts_blocked = 0
+        self.blocked_put_ns = 0
+        self.blocked_get_ns = 0
 
     def register_input(self) -> int:
         """Returns the channel index assigned to a new producer edge."""
@@ -48,9 +59,15 @@ class Channel:
 
     def put(self, ch_idx: int, msg: Any) -> None:
         with self._not_full:
-            while len(self._q) >= self.capacity:
-                self._not_full.wait()
+            if len(self._q) >= self.capacity:
+                self.puts_blocked += 1
+                t0 = time.monotonic_ns()
+                while len(self._q) >= self.capacity:
+                    self._not_full.wait()
+                self.blocked_put_ns += time.monotonic_ns() - t0
             self._q.append((ch_idx, msg))
+            if len(self._q) > self.depth_max:
+                self.depth_max = len(self._q)
             self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Tuple[int, Any]]:
@@ -60,18 +77,25 @@ class Channel:
         restart it, so the idle tick is never delayed past ``timeout``."""
         if timeout is None:
             with self._not_empty:
-                while not self._q:
-                    self._not_empty.wait()
+                if not self._q:
+                    t0 = time.monotonic_ns()
+                    while not self._q:
+                        self._not_empty.wait()
+                    self.blocked_get_ns += time.monotonic_ns() - t0
                 item = self._q.popleft()
                 self._not_full.notify()
                 return item
         deadline = time.monotonic() + timeout
         with self._not_empty:
-            while not self._q:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return None
-                self._not_empty.wait(remaining)
+            if not self._q:
+                t0 = time.monotonic_ns()
+                while not self._q:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.blocked_get_ns += time.monotonic_ns() - t0
+                        return None
+                    self._not_empty.wait(remaining)
+                self.blocked_get_ns += time.monotonic_ns() - t0
             item = self._q.popleft()
             self._not_full.notify()
             return item

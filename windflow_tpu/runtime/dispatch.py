@@ -48,6 +48,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..monitoring.tracing import device_span
+
 _DEFAULT_DEPTH = 2
 
 
@@ -68,6 +70,10 @@ class DeviceDispatchQueue:
     def __init__(self, stats=None, depth: Optional[int] = None) -> None:
         self.depth = dispatch_depth() if depth is None else max(0, depth)
         self.stats = stats
+        # jax.profiler span label so captured device traces line up with
+        # the Dispatch_commit stats (prep span lives in the replica)
+        self._span_commit = "wf:commit:" + (
+            stats.op_name if stats is not None and stats.op_name else "?")
         self._q: "deque[Callable[[], None]]" = deque()
 
     def __len__(self) -> int:
@@ -118,7 +124,8 @@ class DeviceDispatchQueue:
     def _run(self, commit: Callable[[], None]) -> None:
         t0 = time.perf_counter()
         try:
-            commit()
+            with device_span(self._span_commit):
+                commit()
         except BaseException:
             self.abort()
             raise

@@ -45,6 +45,11 @@ class BasicEmitter:
         self._emit_count = 0
         self._last_punct_usec = current_time_usecs()
         self.stats = None  # optional StatsRecord of the owning replica
+        # transient latency-tracing origin stamp: the owning replica (or
+        # source shipper) sets it just before emit; the first message
+        # created while it is non-zero carries it and clears it
+        # (monitoring/tracing.py — 0 means "current tuple untraced")
+        self.trace_ts = 0
 
     # -- wiring ------------------------------------------------------------
     def set_stats(self, stats) -> None:
@@ -63,6 +68,9 @@ class BasicEmitter:
         msg = Single(payload,
                      self._next_ids[dest] if msg_id is None else msg_id,
                      ts, wm)
+        if self.trace_ts:
+            msg.trace_ts = self.trace_ts
+            self.trace_ts = 0
         self._next_ids[dest] += 1
         if self.stats is not None:
             self.stats.outputs_sent += 1
@@ -153,6 +161,9 @@ class ForwardEmitter(BasicEmitter):
             if self._batch is None:
                 self._batch = Batch()
             self._batch.add_tuple(payload, ts, wm)
+            if self.trace_ts:
+                self._batch.note_trace(self.trace_ts)
+                self.trace_ts = 0
             if self._batch.size >= self.output_batch_size:
                 self._send_batch(self._rr, self._batch)
                 self._rr = (self._rr + 1) % self.num_dests
@@ -189,6 +200,9 @@ class KeyByEmitter(BasicEmitter):
             if b is None:
                 b = self._batches[dest] = Batch()
             b.add_tuple(payload, ts, wm)
+            if self.trace_ts:
+                b.note_trace(self.trace_ts)
+                self.trace_ts = 0
             if b.size >= self.output_batch_size:
                 self._send_batch(dest, b)
                 self._batches[dest] = None
@@ -223,6 +237,9 @@ class BroadcastEmitter(BasicEmitter):
             if self._batch is None:
                 self._batch = Batch()
             self._batch.add_tuple(payload, ts, wm)
+            if self.trace_ts:
+                self._batch.note_trace(self.trace_ts)
+                self.trace_ts = 0
             if self._batch.size >= self.output_batch_size:
                 self._broadcast_batch(self._batch)
                 self._batch = None
@@ -273,15 +290,21 @@ class SplittingEmitter(BasicEmitter):
              msg_id: Optional[int] = None) -> None:
         sel = self.splitting_logic(payload)
         if sel is None:
+            self.trace_ts = 0
             return
+        t0 = self.trace_ts
+        if t0:
+            self.trace_ts = 0
         n = len(self.inner)
         if isinstance(sel, int):
-            self.inner[check_branch_index(sel, n)].emit(payload, ts, wm,
-                                                        msg_id)
+            inner = self.inner[check_branch_index(sel, n)]
+            inner.trace_ts = t0
+            inner.emit(payload, ts, wm, msg_id)
         else:
             for s in sel:
-                self.inner[check_branch_index(s, n)].emit(payload, ts, wm,
-                                                          msg_id)
+                inner = self.inner[check_branch_index(s, n)]
+                inner.trace_ts = t0
+                inner.emit(payload, ts, wm, msg_id)
 
     def propagate_punctuation(self, wm: int) -> None:
         for e in self.inner:

@@ -87,11 +87,17 @@ class Worker(threading.Thread):
         # drain, so a fully idle graph doesn't wake every worker at 20 Hz
         # on a small host; any real message resets the cadence
         idle_streak = 0
+        # idle ticks are observability too: attribute them to the first
+        # chain node that owns a StatsRecord (Worker_idle_ticks)
+        stats = next((n.stats for n in self.chain
+                      if getattr(n, "stats", None) is not None), None)
         while self._eos_seen < n_inputs:
             backoff = idle_s if idle_s is None else idle_s * min(
                 16, 1 << min(idle_streak, 4))
             item = self.channel.get(backoff)
             if item is None:  # idle tick
+                if stats is not None:
+                    stats.worker_idle_ticks += 1
                 did_work = False
                 for sink in idle_sinks:
                     did_work = bool(sink.on_idle()) or did_work

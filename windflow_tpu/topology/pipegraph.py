@@ -291,6 +291,9 @@ class PipeGraph:
             channel = None
             if not stage.is_source:
                 channel = stage.channels[i]
+                # queue-occupancy/backpressure gauges: the consumer's
+                # stats record reads its input channel live (Queue_*)
+                stage.first_op.replicas[i].stats.input_channel = channel
                 coll = self._make_collector(stage, i)
                 if coll is not None:
                     chain.append(coll)

@@ -57,7 +57,7 @@ def bucket_capacity(n: int, minimum: int = 8) -> int:
 class BatchTPU(StreamMsg):
     __slots__ = ("fields", "ts_host", "size", "capacity", "wm", "is_punct",
                  "stream_tag", "id", "schema", "host_keys", "key_slots",
-                 "slot_of_key")
+                 "slot_of_key", "trace_min", "trace_max")
 
     def __init__(self, fields: Dict[str, Any], ts_host: np.ndarray, size: int,
                  schema: TupleSchema, wm: int = 0,
@@ -77,6 +77,10 @@ class BatchTPU(StreamMsg):
         self.host_keys = host_keys  # list of python keys, len == size
         self.key_slots = key_slots  # jax int32 (capacity,): dense slot ids
         self.slot_of_key = slot_of_key  # key -> slot id for this batch
+        # latency-tracing origin stamps: min/max over traced constituents
+        # (0 = none traced; monitoring/tracing.py)
+        self.trace_min = 0
+        self.trace_max = 0
 
     # -- protocol ----------------------------------------------------------
     def min_watermark(self) -> int:
@@ -166,6 +170,13 @@ class BatchTPU(StreamMsg):
         host_cols = {name: np.asarray(v) for name, v in self.fields.items()}
         return self.schema.from_columns(host_cols, self.ts_host, self.size)
 
+    def copy_trace_from(self, src: "BatchTPU") -> "BatchTPU":
+        """Propagate origin stamps from the batch this one derives from
+        (operator outputs, gathers, compactions)."""
+        self.trace_min = src.trace_min
+        self.trace_max = src.trace_max
+        return self
+
     def with_fields(self, new_fields: Dict[str, Any]) -> "BatchTPU":
         """Same metadata, new device columns (in-place operator output)."""
         b = BatchTPU(new_fields, self.ts_host, self.size, self.schema,
@@ -173,7 +184,7 @@ class BatchTPU(StreamMsg):
                      self.slot_of_key)
         b.stream_tag = self.stream_tag
         b.id = self.id
-        return b
+        return b.copy_trace_from(self)
 
     def copy_for_dest(self) -> "BatchTPU":
         """Broadcast copy: device arrays are immutable, sharing is safe."""
@@ -182,7 +193,7 @@ class BatchTPU(StreamMsg):
                      self.slot_of_key)
         b.stream_tag = self.stream_tag
         b.id = self.id
-        return b
+        return b.copy_trace_from(self)
 
     @property
     def num_keys(self) -> int:

@@ -59,6 +59,9 @@ class TPUStageEmitter(BasicEmitter):
         self._rows: List[list] = [[] for _ in range(n_bufs)]
         self._keys: List[list] = [[] for _ in range(n_bufs)]
         self._wms: List[int] = [0] * n_bufs
+        # per-buffer min/max origin stamps of traced rows (latency tracing)
+        self._trace_lo: List[int] = [0] * n_bufs
+        self._trace_hi: List[int] = [0] * n_bufs
         self._rr = 0
         # time-bounded staging (reference: the GPU keyby emitter flushes
         # partial batches rather than parking them, keyby_emitter_gpu.hpp:
@@ -110,6 +113,13 @@ class TPUStageEmitter(BasicEmitter):
         elif wm < self._wms[buf]:
             self._wms[buf] = wm
         rows.append((payload, ts))
+        if self.trace_ts:  # traced row: fold its stamp into the buffer
+            t0 = self.trace_ts
+            self.trace_ts = 0
+            if self._trace_lo[buf] == 0 or t0 < self._trace_lo[buf]:
+                self._trace_lo[buf] = t0
+            if t0 > self._trace_hi[buf]:
+                self._trace_hi[buf] = t0
         if self.key_extractor is not None:
             self._keys[buf].append(key)
         if len(rows) >= self.output_batch_size:
@@ -174,6 +184,9 @@ class TPUStageEmitter(BasicEmitter):
             self.stats.outputs_sent += len(rows)
             self.stats.device_bytes_h2d += batch.nbytes()
             self._update_pool_stats()
+        batch.trace_min = self._trace_lo[buf]
+        batch.trace_max = self._trace_hi[buf]
+        self._trace_lo[buf] = self._trace_hi[buf] = 0
         self._rows[buf] = []
         self._keys[buf] = []
         self._first_append[buf] = None
@@ -214,6 +227,10 @@ class TPUStageEmitter(BasicEmitter):
         if self.schema is None:
             self.schema = TupleSchema(
                 {k: np.asarray(v).dtype for k, v in cols.items()})
+        # capture the columnar push's trace stamp before flush() consumes
+        # buffer state; every batch this push creates carries it
+        t_trace = self.trace_ts
+        self.trace_ts = 0
         self.flush()  # row-staged partials go first (ordering)
         n = len(ts_arr)
         if self.routing == "keyby":
@@ -248,6 +265,8 @@ class TPUStageEmitter(BasicEmitter):
                 b = BatchTPU.stage_columns(
                     sub, ts_arr[idx], self.schema, wm,
                     kcol[idx], self.recycler)
+                if t_trace:
+                    b.trace_min = b.trace_max = t_trace
                 self._send_device(d, b)
         else:
             # copy: the caller may reuse its arrays after push_columns
@@ -258,6 +277,8 @@ class TPUStageEmitter(BasicEmitter):
                 keys = _stack_key_fields(cols, self.key_fields, n)
             b = BatchTPU.stage_columns(cols, ts_arr, self.schema, wm, keys,
                                        self.recycler)
+            if t_trace:
+                b.trace_min = b.trace_max = t_trace
             if self.routing == "broadcast":
                 for d in range(self.num_dests):
                     # device arrays are shared: one H2D transfer, count once
@@ -369,6 +390,9 @@ class _D2HPipeline:
 
     def _pipe_add(self, batch: BatchTPU) -> None:
         self._pending.append((time.monotonic(), batch))
+        stats = getattr(self, "stats", None)
+        if stats is not None:
+            stats.note_pipe_depth(len(self._pending))
         while len(self._pending) > self.depth:
             self._pipe_process(self._pending.popleft()[1])
         if self._max_age_s is not None:
@@ -692,7 +716,7 @@ def gather_sub_batch(batch: BatchTPU, idx: np.ndarray,
     keys2 = host_keys
     sub = BatchTPU(sub_fields, ts2, idx.size, batch.schema, batch.wm, keys2)
     sub.stream_tag = batch.stream_tag
-    return sub
+    return sub.copy_trace_from(batch)
 
 
 class TPUKeyByEmitter(BasicEmitter, _D2HPipeline):
@@ -961,8 +985,13 @@ class TPUExitEmitter(BasicEmitter, _D2HPipeline):
     def _pipe_process(self, batch: BatchTPU) -> None:
         if self.stats is not None:
             self.stats.device_bytes_d2h += batch.nbytes()
+        if batch.trace_min:
+            # one traced row re-materializes per traced batch: the inner
+            # emitter consumes the stamp on its first emit
+            self.inner.trace_ts = batch.trace_min
         for payload, ts in batch.to_rows():
             self.inner.emit(payload, ts, batch.wm)
+        self.inner.trace_ts = 0
 
     def emit_device_batch(self, batch: BatchTPU) -> None:
         batch.prefetch_host()

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
+from ..monitoring.tracing import device_span
 from ..operators.base import BasicOperator, BasicReplica
 from ..runtime.dispatch import DeviceDispatchQueue
 from .batch import BatchTPU, key_column_np, key_column_to_list
@@ -84,6 +85,10 @@ class TPUReplicaBase(BasicReplica):
     def __init__(self, op: BasicOperator, idx: int) -> None:
         super().__init__(op, idx)
         self.dispatch = DeviceDispatchQueue(stats=self.stats)
+        # jax.profiler span label for the host-prep stage, so captured
+        # device traces line up with the Dispatch_* stats (the commit
+        # span lives in the dispatch queue)
+        self._span_prep = f"wf:prep:{op.name}"
 
     def handle_msg(self, ch: int, msg: Any) -> None:
         if msg.is_punct:
@@ -102,10 +107,13 @@ class TPUReplicaBase(BasicReplica):
         self.stats.start_svc()
         self.stats.inputs_received += msg.size
         self.stats.device_batches_in += 1
+        if self.stats.sample_every:  # per batch, not per tuple
+            self.stats._svc_rec = True
         self._advance_wm(msg.wm)
         msg.wm = self.cur_wm
         t0 = time.perf_counter()
-        commit = self.prep_device_batch(msg)
+        with device_span(self._span_prep):
+            commit = self.prep_device_batch(msg)
         prep_us = (time.perf_counter() - t0) * 1e6
         if commit is not None:
             self.dispatch.submit(commit, prep_us)
@@ -157,6 +165,7 @@ class TPUReplicaBase(BasicReplica):
         nb = BatchTPU(out_fields, ts2, new_size, batch.schema, batch.wm,
                       keys2)
         nb.stream_tag = batch.stream_tag
+        nb.copy_trace_from(batch)
         if new_size > 0:
             self._emit_batch(nb)
 
@@ -656,6 +665,7 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
                       dtype=np.int64)
         nb = BatchTPU(out, ts, 1, batch.schema, batch.wm)
         nb.stream_tag = batch.stream_tag
+        nb.copy_trace_from(batch)
         self._emit_batch(nb)
 
 
@@ -740,6 +750,7 @@ class ReduceTPUReplica(TPUReplicaBase):
             nb = BatchTPU(out_fields, ts2, n_out, batch.schema, batch.wm,
                           out_keys)
             nb.stream_tag = batch.stream_tag
+            nb.copy_trace_from(batch)
             self._emit_batch(nb)
 
         return commit
