@@ -36,3 +36,10 @@ def _strip_remote_backends():
 
 
 _strip_remote_backends()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running matrix tests excluded from tier-1 "
+        "(-m 'not slow')")

@@ -1,0 +1,504 @@
+"""Aligned-barrier checkpointing: store semantics, crash-injection
+recovery, Kafka offset commit, and the DBHandle durability fix.
+
+The crash harness kills a pipeline at a configurable tuple count (a
+deterministic exception inside the source functor — the same unwind path
+a real replica crash takes), restarts a fresh topology with
+``restore_from=``, and asserts that the merged results equal an
+uninterrupted run. Sinks are keyed idempotent stores ``(key, window id)
+-> value``; the merge gives the restored run priority because the
+crashed run's emergency-EOS cascade flushes PARTIAL windows downstream
+(at-least-once: the restored run re-fires them completely).
+
+The fast smoke path (keyed CB windows) is tier-1; the full operator
+matrix (FFAT CPU/TPU, stateful device scan, persistent reduce) is
+``slow``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph, Reduce,
+                          Sink_Builder, Source_Builder, TimePolicy, WinType)
+from windflow_tpu.checkpoint import CheckpointStore
+from windflow_tpu.persistent.db_handle import DBHandle
+
+
+class InjectedCrash(Exception):
+    pass
+
+
+class ReplaySource:
+    """Deterministic replayable source: integers 0..n-1 keyed ``v % nk``,
+    checkpoint requested at ``ckpt_at``, crash injected at ``crash_at``."""
+
+    def __init__(self, n, nk=5, ckpt_at=None, crash_at=None):
+        self.n = n
+        self.nk = nk
+        self.ckpt_at = ckpt_at
+        self.crash_at = crash_at
+        self.pos = 0
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.crash_at is not None and self.pos == self.crash_at:
+                raise InjectedCrash(f"killed at tuple {self.pos}")
+            v = self.pos
+            shipper.push({"k": v % self.nk, "v": v})
+            self.pos += 1
+            if self.ckpt_at is not None and self.pos == self.ckpt_at:
+                assert shipper.request_checkpoint() is not None
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+# ---------------------------------------------------------------------------
+# pipeline builders for the recovery matrix: (store, source, results) -> graph
+# ---------------------------------------------------------------------------
+def _keyed_windows_graph(store, src, results, tmp):
+    g = PipeGraph("ck_kw", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results[(t.key, t.wid)] = t.value
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(win) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def _ffat_cpu_graph(store, src, results, tmp):
+    from windflow_tpu.operators.ffat import Ffat_Windows
+
+    g = PipeGraph("ck_ffat", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    ff = Ffat_Windows(lambda t: t["v"], lambda a, b: a + b,
+                      key_extractor=lambda t: t["k"], win_len=4, slide_len=2,
+                      win_type=WinType.CB, name="ffat", parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results[(t.key, t.wid)] = t.value
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(ff) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def _ffat_tpu_graph(store, src, results, tmp):
+    from windflow_tpu.tpu.builders_tpu import Ffat_Windows_TPU_Builder
+
+    g = PipeGraph("ck_fftpu", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    ff = (Ffat_Windows_TPU_Builder(lambda f: {"s": f["v"]},
+                                   lambda a, b: {"s": a["s"] + b["s"]})
+          .with_key_by("k").with_cb_windows(4, 2).with_name("fftpu").build())
+
+    def sink(t):
+        if t is not None:
+            results[(int(t["k"]), int(t["wid"]))] = int(t["s"])
+
+    g.add_source(Source_Builder(src).with_name("src")
+                 .with_output_batch_size(64).build()) \
+        .add(ff) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def _stateful_map_tpu_graph(store, src, results, tmp):
+    import numpy as np
+
+    from windflow_tpu.tpu.builders_tpu import Map_TPU_Builder
+
+    g = PipeGraph("ck_smap", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    smap = (Map_TPU_Builder(
+        lambda row, state: ({"k": row["k"], "v": row["v"] + state["acc"]},
+                            {"acc": state["acc"] + row["v"]}))
+        .with_key_by("k").with_state({"acc": np.int64(0)})
+        .with_name("smap").build())
+
+    def sink(t):
+        # running per-key prefix sums are strictly increasing: keeping
+        # the max per key makes the sink idempotent under replay
+        if t is not None:
+            k, v = int(t["k"]), int(t["v"])
+            results[k] = max(v, results.get(k, -1))
+
+    g.add_source(Source_Builder(src).with_name("src")
+                 .with_output_batch_size(64).build()) \
+        .add(smap) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def _persistent_reduce_graph(store, src, results, tmp):
+    from windflow_tpu.persistent.p_basic_ops import P_Reduce
+
+    g = PipeGraph("ck_pred", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    pred = P_Reduce(lambda t, s: (0 if s is None else s) + t["v"],
+                    key_extractor=lambda t: t["k"], initial_state=None,
+                    name="pred", parallelism=2, output_batch_size=0,
+                    db_dir=os.path.join(tmp, "pdb"))
+
+    def sink(s):
+        if s is not None:
+            results[len(results)] = s
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(pred) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g, pred
+
+
+def _run_crash_restart(builder, tmp_path, n=2000, ckpt_at=600, crash_at=1200):
+    """Golden run, crash run, restore run; returns (golden, merged)."""
+    golden = {}
+    builder(str(tmp_path / "gold_store"), ReplaySource(n), golden,
+            str(tmp_path / "gold")).run()
+    store = str(tmp_path / "store")
+    crash_res = {}
+    g = builder(store, ReplaySource(n, ckpt_at=ckpt_at, crash_at=crash_at),
+                crash_res, str(tmp_path / "crash"))
+    with pytest.raises(InjectedCrash):
+        g.run()
+    assert g._coordinator.completed == 1, "checkpoint must commit pre-crash"
+    restore_res = {}
+    g2 = builder(store, ReplaySource(n), restore_res,
+                 str(tmp_path / "crash"))
+    g2.run(restore_from=store)
+    return golden, {**crash_res, **restore_res}
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: keyed windows survive a mid-stream kill byte-identically
+# ---------------------------------------------------------------------------
+def test_recovery_smoke_keyed_windows(tmp_path):
+    golden, merged = _run_crash_restart(_keyed_windows_graph, tmp_path)
+    assert merged == golden
+    assert len(golden) > 0
+
+
+def test_recovery_smoke_records_checkpoint_stats(tmp_path):
+    store = str(tmp_path / "store")
+    res = {}
+    g = _keyed_windows_graph(store, ReplaySource(1000, ckpt_at=400), res,
+                             str(tmp_path))
+    g.run()
+    st = g.get_stats()
+    ck = st["Checkpoints"]
+    assert ck["Checkpoints_completed"] == 1
+    assert ck["Checkpoint_last_bytes"] > 0
+    per_replica = [r for op in st["Operators"] for r in op["replicas"]]
+    assert sum(r["Checkpoint_snapshots"] for r in per_replica) > 0
+    assert sum(r["Checkpoint_bytes_total"] for r in per_replica) > 0
+
+
+def _combined_graph(store, src, results, tmp):
+    """The acceptance pipeline: persistent op + keyed windows + FFAT in
+    ONE dataflow, so one barrier aligns across three stateful planes
+    (sqlite image, pane buffers, FlatFAT ring) before the snapshot."""
+    from windflow_tpu.operators.ffat import Ffat_Windows
+    from windflow_tpu.persistent.p_basic_ops import P_Map
+
+    g = PipeGraph("ck_combined", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    pmap = P_Map(lambda t, s: ({"k": t["k"], "v": t["v"] + (s or 0)},
+                               (s or 0) + t["v"]),
+                 key_extractor=lambda t: t["k"], initial_state=None,
+                 name="pmap", parallelism=2, output_batch_size=0,
+                 db_dir=os.path.join(tmp, "cdb"))
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+    ff = Ffat_Windows(lambda t: t.value, lambda a, b: a + b,
+                      key_extractor=lambda t: t.key, win_len=3, slide_len=3,
+                      win_type=WinType.CB, name="ffat", parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results[(t.key, t.wid)] = t.value
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(pmap) \
+        .add(win) \
+        .add(ff) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def test_recovery_combined_persistent_windows_ffat(tmp_path):
+    golden, merged = _run_crash_restart(_combined_graph, tmp_path,
+                                        n=1500, ckpt_at=500, crash_at=1000)
+    assert merged == golden
+    assert len(golden) > 0
+
+
+# ---------------------------------------------------------------------------
+# crash-injection matrix (slow): every stateful plane
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("builder", [_ffat_cpu_graph, _ffat_tpu_graph,
+                                     _stateful_map_tpu_graph],
+                         ids=["ffat_cpu", "ffat_tpu", "stateful_map_tpu"])
+def test_crash_matrix(builder, tmp_path):
+    golden, merged = _run_crash_restart(builder, tmp_path)
+    assert merged == golden
+    assert len(golden) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("crash_at", [700, 1201, 1999])
+def test_crash_matrix_kill_points(tmp_path, crash_at):
+    golden, merged = _run_crash_restart(_keyed_windows_graph, tmp_path,
+                                        crash_at=crash_at)
+    assert merged == golden
+
+
+def test_persistent_reduce_recovery(tmp_path):
+    """Persistent keyed state: the sqlite contents roll back to the
+    barrier point on restore (the crashed run's post-checkpoint writes
+    must not survive) and the final DB equals an uninterrupted run's."""
+    def read_db(dbdir):
+        out = {}
+        for i in range(2):
+            h = DBHandle(f"pred_r{i}", db_dir=dbdir)
+            out.update(dict(h.items()))
+            h.close()
+        return out
+
+    golden_db = str(tmp_path / "gold" / "pdb")
+    g, _ = _persistent_reduce_graph(str(tmp_path / "gold_store"),
+                                    ReplaySource(1500), {},
+                                    str(tmp_path / "gold"))
+    g.run()
+    golden = read_db(golden_db)
+    assert golden  # keyed sums present
+
+    store = str(tmp_path / "store")
+    g2, _ = _persistent_reduce_graph(
+        store, ReplaySource(1500, ckpt_at=500, crash_at=1000), {},
+        str(tmp_path / "crash"))
+    with pytest.raises(InjectedCrash):
+        g2.run()
+    assert g2._coordinator.completed == 1
+    g3, _ = _persistent_reduce_graph(store, ReplaySource(1500), {},
+                                     str(tmp_path / "crash"))
+    g3.run(restore_from=store)
+    assert read_db(str(tmp_path / "crash" / "pdb")) == golden
+
+
+# ---------------------------------------------------------------------------
+# store semantics
+# ---------------------------------------------------------------------------
+def test_store_atomic_commit_and_retention(tmp_path):
+    store = CheckpointStore(str(tmp_path), retain=2)
+    for cid in (1, 2, 3):
+        store.begin(cid)
+        store.write_blob(cid, "op", 0, {"cid": cid})
+        store.commit(cid, {"graph": "t"})
+    # retention keeps the last 2
+    assert store.completed_ids() == [2, 3]
+    assert store.latest() == 3
+    # an uncommitted (staging) checkpoint is invisible to restore
+    store.begin(4)
+    store.write_blob(4, "op", 0, {"cid": 4})
+    assert store.latest() == 3
+    cid, d, manifest = CheckpointStore.resolve(str(tmp_path))
+    assert cid == 3
+    states = store.load_states(d, manifest)
+    assert states[("op", 0)] == {"cid": 3}
+
+
+def test_store_resolve_specific_checkpoint(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    for cid in (1, 2):
+        store.begin(cid)
+        store.write_blob(cid, "op", 0, {"cid": cid})
+        store.commit(cid, {"graph": "t"})
+    d1 = store.checkpoint_dir(1)
+    cid, _, manifest = CheckpointStore.resolve(d1)
+    assert cid == 1 and manifest["ckpt_id"] == 1
+
+
+def test_store_restage_clears_crashed_debris(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.begin(5)
+    store.write_blob(5, "stale_op", 0, {"old": True})
+    store.begin(5)  # a restarted coordinator re-opens the same epoch
+    store.write_blob(5, "op", 0, {"new": True})
+    store.commit(5, {"graph": "t"})
+    _, d, manifest = CheckpointStore.resolve(str(tmp_path))
+    assert [b for b in manifest["blobs"] if "stale_op" in b] == []
+
+
+def test_restore_rejects_topology_mismatch(tmp_path):
+    store = str(tmp_path / "store")
+    g = _keyed_windows_graph(store, ReplaySource(500, ckpt_at=200), {},
+                             str(tmp_path))
+    g.run()
+    # rebuild with a DIFFERENT operator name: restore must fail loudly
+    g2 = PipeGraph("ck_kw", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g2.with_checkpointing(store_dir=store)
+    g2.add_source(Source_Builder(ReplaySource(500)).with_name("src").build())\
+        .add(Reduce(lambda t, s: (s or 0) + 1, lambda t: t["k"],
+                    name="other_name")) \
+        .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+    from windflow_tpu import WindFlowError
+    with pytest.raises(WindFlowError, match="does not contain"):
+        g2.run(restore_from=store)
+
+
+# ---------------------------------------------------------------------------
+# Kafka: offsets snapshot with the barrier, commit only on finalize
+# ---------------------------------------------------------------------------
+def test_kafka_offsets_commit_on_finalize(tmp_path):
+    from windflow_tpu.kafka.connectors import (Kafka_Sink, Kafka_Source,
+                                               MemoryBroker)
+
+    MemoryBroker.reset()
+    broker = MemoryBroker.get("ckpt")
+    for i in range(400):
+        broker.produce("in", i, partition=i % 4)
+
+    store = str(tmp_path / "store")
+    seen = []
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False  # idle: all 400 consumed
+        seen.append(msg.payload)
+        shipper.push({"v": msg.payload})
+        if len(seen) == 150:
+            shipper.request_checkpoint()
+        return True
+
+    g = PipeGraph("ck_kafka", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    src = Kafka_Source(deser, "memory://ckpt", ["in"], group_id="g1",
+                       idleness_ms=300, name="ksrc")
+    g.add_source(src).add_sink(
+        Sink_Builder(lambda t: None).with_name("snk").build())
+    g.run()
+    assert len(seen) == 400
+    assert g._coordinator.completed == 1
+    # committed group offsets == positions at the checkpoint (150 consumed),
+    # NOT the final positions (400): commits ride checkpoint finalize only
+    committed = {k: v for k, v in broker.committed.items() if k[0] == "g1"}
+    assert sum(committed.values()) == 150
+    # the checkpoint blob carries the same replayable offsets
+    cid, d, manifest = CheckpointStore.resolve(store)
+    st = CheckpointStore(store).load_states(d, manifest)[("ksrc", 0)]
+    assert sum(st["offsets"].values()) == 150
+
+
+@pytest.mark.slow
+def test_kafka_restore_consumes_remainder(tmp_path):
+    from windflow_tpu.kafka.connectors import Kafka_Source, MemoryBroker
+
+    MemoryBroker.reset()
+    broker = MemoryBroker.get("ckpt2")
+    for i in range(300):
+        broker.produce("in", i, partition=i % 4)
+
+    store = str(tmp_path / "store")
+
+    def make_deser(out, ckpt_at=None, stop_at=None):
+        def deser(msg, shipper):
+            if msg is None:
+                return False
+            out.append(msg.payload)
+            shipper.push({"v": msg.payload})
+            if ckpt_at is not None and len(out) == ckpt_at:
+                shipper.request_checkpoint()
+            if stop_at is not None and len(out) >= stop_at:
+                return False
+            return True
+        return deser
+
+    def build(deser):
+        g = PipeGraph("ck_kafka2", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        g.add_source(Kafka_Source(deser, "memory://ckpt2", ["in"],
+                                  group_id="g2", idleness_ms=300,
+                                  name="ksrc")) \
+            .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+        return g
+
+    run1 = []
+    build(make_deser(run1, ckpt_at=100, stop_at=180)).run()
+    run2 = []
+    build(make_deser(run2)).run(restore_from=store)
+    # restored run resumes from the checkpointed offsets: together the two
+    # runs cover every message, overlapping exactly on the replayed span
+    assert sorted(run1[:100] + run2) == sorted(range(300))
+
+
+# ---------------------------------------------------------------------------
+# DBHandle durability (satellite): commit folds the WAL; snapshot/restore
+# round-trips; torn temp files never corrupt
+# ---------------------------------------------------------------------------
+def test_dbhandle_commit_is_self_contained(tmp_path):
+    import shutil
+    import sqlite3
+
+    db = DBHandle("t", db_dir=str(tmp_path))
+    for i in range(50):
+        db.put(i, {"v": i})
+    db.commit()
+    # copying ONLY the main .db file (no -wal) must preserve every commit:
+    # before the fix, committed rows lived in the WAL side file and a
+    # crash/backup that lost it silently dropped them
+    copy = str(tmp_path / "copy.db")
+    shutil.copyfile(db.path, copy)
+    conn = sqlite3.connect(copy)
+    n = conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+    conn.close()
+    db.close()
+    assert n == 50
+
+
+def test_dbhandle_snapshot_restore_roundtrip(tmp_path):
+    db = DBHandle("t", db_dir=str(tmp_path))
+    db.put("a", 1)
+    db.put("b", 2)
+    blob = db.snapshot_bytes()
+    db.put("a", 99)  # post-snapshot mutation
+    db.put("c", 3)
+    db.restore_bytes(blob)
+    assert dict(db.items()) == {"a": 1, "b": 2}
+    db.close()
+
+
+def test_dbhandle_export_atomic_ignores_torn_tmp(tmp_path):
+    db = DBHandle("t", db_dir=str(tmp_path))
+    db.put("k", "v")
+    target = str(tmp_path / "export.db")
+    # a torn write from a previous crash must never shadow the export
+    with open(target + ".tmp", "wb") as f:
+        f.write(b"garbage")
+    db.export_to(target)
+    import sqlite3
+    conn = sqlite3.connect(target)
+    assert conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0] == 1
+    conn.close()
+    db.close()

@@ -1,0 +1,33 @@
+"""Aligned-barrier checkpointing and crash recovery.
+
+Flink-style asynchronous aligned snapshots over the WindFlow dataflow
+graph (the reference has no fault tolerance at all — SURVEY.md §5):
+
+- a ``CheckpointCoordinator`` (owned by the ``PipeGraph``) periodically
+  bumps a checkpoint epoch; source replicas notice at the next tuple
+  boundary, snapshot their replay position, and inject a ``Barrier``
+  message (``message.py``) downstream on every edge;
+- each worker aligns barriers across its input channels (buffering
+  post-barrier input from already-barriered channels — no post-barrier
+  tuple can leak into a pre-barrier snapshot), drains its device dispatch
+  pipeline, flushes partial output batches, forwards the barrier, and
+  snapshots every fused replica's state (keyed tables, window panes, FFAT
+  forests via ``jax.device_get``, persistent DB contents, collector
+  buffers) into the ``CheckpointStore``;
+- when every worker has acknowledged, the coordinator atomically commits
+  the checkpoint (manifest + rename) and notifies listeners (the Kafka
+  source commits consumer offsets only then — at-least-once end to end);
+- ``PipeGraph.run(restore_from=...)`` rebuilds the topology, restores
+  every replica from the manifest's blobs, and resumes sources from the
+  recorded positions.
+
+DrJAX's observation (PAPERS.md) that MapReduce-style state movement is
+cheap when state lives in arrays is what keeps device snapshots small
+here: a grid-scan table or FFAT forest is a handful of ``device_get``
+calls per replica, not a per-operator serializer.
+"""
+
+from .coordinator import CheckpointCoordinator
+from .store import CheckpointStore
+
+__all__ = ["CheckpointCoordinator", "CheckpointStore"]

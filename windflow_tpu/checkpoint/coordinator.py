@@ -1,0 +1,205 @@
+"""CheckpointCoordinator: epoch generation, ack collection, atomic commit.
+
+One coordinator per running PipeGraph. Triggering is a single integer bump
+of ``requested_id``; source replicas poll it on their own threads at tuple
+boundaries and inject the ``Barrier`` themselves, so the coordinator never
+touches a channel and needs no per-message synchronization. Each worker
+acknowledges a checkpoint exactly once, shipping all of its fused
+replicas' snapshot blobs; the checkpoint commits (manifest + atomic
+rename, ``store.py``) when every worker of the graph has acked. Finalize
+listeners run on the acking worker's thread — they must be cheap and
+thread-safe (the Kafka source only flips a flag and commits offsets from
+its own consume loop).
+
+A checkpoint that can never complete (a source finished before the
+barrier, a worker crashed) simply stays uncommitted: restore only ever
+sees fully-acked checkpoints, which is the correctness contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .store import CheckpointStore
+
+
+class CheckpointCoordinator:
+    def __init__(self, store: CheckpointStore, graph_name: str = "pipegraph",
+                 interval_s: Optional[float] = None) -> None:
+        self.store = store
+        self.graph_name = graph_name
+        self.interval_s = interval_s
+        # the epoch counter source replicas poll (reads are a single
+        # attribute load — safe without the lock; writes hold it).
+        # _alloc_id hands out ids BEFORE they publish, so two concurrent
+        # triggers can never share an epoch
+        self.requested_id = 0
+        self._alloc_id = 0
+        # workers expected to ack each checkpoint; set by PipeGraph once
+        # the topology is built (0 = not running, acks park as pending)
+        self.expected_acks = 0
+        self._lock = threading.Lock()
+        # serializes blob writes against the commit rename: an ack's
+        # pending-check + write must be atomic w.r.t. _finalize renaming
+        # the staging dir away, or a late writer (a retiring worker
+        # racing the last live ack) loses its temp file mid-write and
+        # leaks unmanifested blobs into the committed dir. Ordering:
+        # _store_lock outside _lock, never the reverse.
+        self._store_lock = threading.Lock()
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        # workers that exited cleanly, with their final state blobs: a
+        # finished worker's state is frozen, so its final snapshot is
+        # valid for every later epoch (Flink's finished-task semantics —
+        # without this, one short-lived source would forever block
+        # checkpoints of a still-running graph)
+        self._retired: Dict[str, Dict[Any, Any]] = {}
+        self._listeners: List[Callable[[int], None]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # aggregate stats (PipeGraph.get_stats / the /metrics plane)
+        self.completed = 0
+        self.last_completed_id = 0
+        self.last_duration_s = 0.0
+        self.last_bytes = 0
+        self.total_bytes = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.interval_s is None or self.interval_s <= 0 \
+                or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self.graph_name}/ckpt-coord",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=3)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.trigger()
+
+    # -- triggering --------------------------------------------------------
+    def trigger(self, force: bool = False) -> Optional[int]:
+        """Open a new checkpoint epoch and return its id. Without
+        ``force``, declines while an earlier checkpoint is still
+        in flight (aligned barriers serialize naturally; overlapping
+        epochs would only race each other at the aligners)."""
+        timeout = max(2.0 * (self.interval_s or 0.0), 10.0)
+        with self._lock:
+            if not force:
+                now = time.monotonic()
+                for ent in self._pending.values():
+                    if now - ent["t0"] < timeout:
+                        return None
+            self._alloc_id = max(self._alloc_id, self.requested_id) + 1
+            cid = self._alloc_id
+            self._pending[cid] = {"acked": set(), "bytes": 0,
+                                  "t0": time.monotonic()}
+        # stage BEFORE publishing the epoch: sources poll requested_id and
+        # may ack immediately — clearing crashed-run debris after that
+        # would race their blob writes
+        with self._store_lock:
+            self.store.begin(cid)
+        with self._lock:
+            if cid > self.requested_id:
+                self.requested_id = cid
+            retired = list(self._retired.items())
+        for wname, blobs in retired:
+            self.ack(cid, wname, blobs)
+        return cid
+
+    # -- acks --------------------------------------------------------------
+    def ack(self, ckpt_id: int, worker_name: str,
+            blobs: Dict[Any, Any]) -> int:
+        """One worker's snapshot for one checkpoint: ``blobs`` maps
+        ``(op_name, replica_idx)`` to the replica's state dict. Returns
+        bytes written (0 when the checkpoint is unknown/already
+        committed — a late barrier after a commit-by-timeout)."""
+        nbytes = 0
+        with self._store_lock:
+            with self._lock:
+                if ckpt_id not in self._pending:
+                    return 0
+            for (op_name, idx), state in blobs.items():
+                nbytes += self.store.write_blob(ckpt_id, op_name, idx,
+                                                state)
+        with self._lock:
+            ent = self._pending.get(ckpt_id)
+            if ent is None:
+                return nbytes
+            ent["acked"].add(worker_name)
+            ent["bytes"] += nbytes
+            done = (self.expected_acks > 0
+                    and len(ent["acked"]) >= self.expected_acks)
+        if done:
+            self._finalize(ckpt_id)
+        return nbytes
+
+    def retire(self, worker_name: str, blobs: Dict[Any, Any]) -> None:
+        """A worker finished cleanly: remember its final blobs and ack
+        them into every epoch it had not answered yet (its barrier can no
+        longer be in flight — it saw EOS on every channel)."""
+        with self._lock:
+            self._retired[worker_name] = blobs
+            open_cids = [cid for cid, ent in self._pending.items()
+                         if worker_name not in ent["acked"]]
+        for cid in open_cids:
+            self.ack(cid, worker_name, blobs)
+
+    def _finalize(self, ckpt_id: int) -> None:
+        with self._lock:
+            ent = self._pending.pop(ckpt_id, None)
+            if ent is None:
+                return  # raced another finalize
+            # any older still-open checkpoint can no longer matter: the
+            # newer one strictly supersedes it
+            for old in [c for c in self._pending if c < ckpt_id]:
+                self._pending.pop(old, None)
+            listeners = list(self._listeners)
+        duration = time.monotonic() - ent["t0"]
+        with self._store_lock:
+            self.store.commit(ckpt_id, {
+                "graph": self.graph_name,
+                "created_unix": time.time(),
+                "duration_sec": round(duration, 6),
+                "n_workers": self.expected_acks,
+                "bytes": ent["bytes"],
+            })
+        with self._lock:
+            self.completed += 1
+            self.last_completed_id = ckpt_id
+            self.last_duration_s = duration
+            self.last_bytes = ent["bytes"]
+            self.total_bytes += ent["bytes"]
+        for fn in listeners:
+            try:
+                fn(ckpt_id)
+            except Exception:  # listener bugs must not kill the worker
+                pass
+
+    # -- listeners ---------------------------------------------------------
+    def add_finalize_listener(self, fn: Callable[[int], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "Checkpoints_completed": self.completed,
+                "Checkpoints_requested": self.requested_id,
+                "Checkpoint_last_id": self.last_completed_id,
+                "Checkpoint_last_duration_sec": round(self.last_duration_s,
+                                                      6),
+                "Checkpoint_last_bytes": self.last_bytes,
+                "Checkpoint_bytes_total": self.total_bytes,
+                "Checkpoint_store_dir": self.store.root,
+            }

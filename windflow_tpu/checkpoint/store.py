@@ -1,0 +1,192 @@
+"""CheckpointStore: the versioned on-disk layout of aligned snapshots.
+
+Layout under one root directory::
+
+    <root>/
+      ckpt_0000000003.inprogress/     # staging: blobs land here first
+        reduce_1a2b3c4d__0.blob
+        source_5e6f7a8b__0.blob
+      ckpt_0000000002/                # committed: manifest present
+        manifest.json
+        *.blob
+
+Every write is crash-safe by construction: blobs and the manifest are
+written to a ``.tmp`` sibling and published with ``os.replace`` (atomic
+rename on POSIX), and a checkpoint becomes visible as a whole only when
+its staging directory is atomically renamed to the final name. A crash at
+any point leaves either the previous committed checkpoint intact or a
+``.inprogress`` directory that restore ignores. Retention keeps the last
+``retain`` committed checkpoints.
+
+Blob files are named ``<sanitized-op-name>_<crc32>__<replica>.blob`` (the
+crc disambiguates op names that sanitize identically); each blob pickles
+``{"op": <exact name>, "replica": idx, "state": <replica state dict>}``
+so restore matches replicas by exact name, never by file name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+_CKPT_RE = re.compile(r"^ckpt_(\d{10})$")
+
+
+def blob_name(op_name: str, replica_idx: int) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in op_name)
+    crc = zlib.crc32(op_name.encode("utf-8", "surrogatepass")) & 0xFFFFFFFF
+    return f"{safe}_{crc:08x}__{replica_idx}.blob"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class CheckpointStore:
+    def __init__(self, root: str, retain: int = 3) -> None:
+        self.root = root
+        self.retain = max(1, int(retain))
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def _dirname(self, ckpt_id: int, staging: bool = False) -> str:
+        d = os.path.join(self.root, f"ckpt_{ckpt_id:010d}")
+        return d + ".inprogress" if staging else d
+
+    def begin(self, ckpt_id: int) -> None:
+        """Start (or restart) staging for a checkpoint: stale debris from
+        a crashed attempt at the same id must not leak into the manifest."""
+        staging = self._dirname(ckpt_id, staging=True)
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging, exist_ok=True)
+
+    # -- writes ------------------------------------------------------------
+    def write_blob(self, ckpt_id: int, op_name: str, replica_idx: int,
+                   state: Any) -> int:
+        """Pickle one replica's snapshot into the staging dir (atomic
+        tmp+rename). Returns the byte size written."""
+        staging = self._dirname(ckpt_id, staging=True)
+        os.makedirs(staging, exist_ok=True)
+        payload = pickle.dumps(
+            {"op": op_name, "replica": replica_idx, "state": state},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        _atomic_write(os.path.join(staging,
+                                   blob_name(op_name, replica_idx)), payload)
+        return len(payload)
+
+    def staged_blobs(self, ckpt_id: int) -> List[str]:
+        staging = self._dirname(ckpt_id, staging=True)
+        try:
+            return sorted(f for f in os.listdir(staging)
+                          if f.endswith(".blob"))
+        except FileNotFoundError:
+            return []
+
+    def commit(self, ckpt_id: int, manifest: Dict[str, Any]) -> str:
+        """Finalize: manifest into staging, then one atomic directory
+        rename makes the whole checkpoint visible. Prunes old ones."""
+        staging = self._dirname(ckpt_id, staging=True)
+        final = self._dirname(ckpt_id)
+        manifest = dict(manifest)
+        manifest.setdefault("format", FORMAT_VERSION)
+        manifest["ckpt_id"] = ckpt_id
+        manifest["blobs"] = self.staged_blobs(ckpt_id)
+        _atomic_write(os.path.join(staging, MANIFEST),
+                      json.dumps(manifest, indent=1).encode())
+        shutil.rmtree(final, ignore_errors=True)  # same-id re-commit
+        os.replace(staging, final)
+        self.prune()
+        return final
+
+    def prune(self) -> None:
+        done = self.completed_ids()
+        for cid in done[:-self.retain]:
+            shutil.rmtree(self._dirname(cid), ignore_errors=True)
+        # staging debris older than the newest committed checkpoint can
+        # never complete (its coordinator is gone) — clean it up too
+        if done:
+            for name in os.listdir(self.root):
+                if name.endswith(".inprogress"):
+                    m = _CKPT_RE.match(name[:-len(".inprogress")])
+                    if m and int(m.group(1)) <= done[-1]:
+                        shutil.rmtree(os.path.join(self.root, name),
+                                      ignore_errors=True)
+
+    # -- reads -------------------------------------------------------------
+    def completed_ids(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _CKPT_RE.match(name)
+            if m and os.path.exists(os.path.join(self.root, name, MANIFEST)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest(self) -> Optional[int]:
+        ids = self.completed_ids()
+        return ids[-1] if ids else None
+
+    def checkpoint_dir(self, ckpt_id: int) -> Optional[str]:
+        """Directory holding a checkpoint's blobs: the committed dir when
+        present, else the staging dir (diagnostics/tests only — restore
+        goes through ``resolve`` and accepts committed checkpoints only)."""
+        final = self._dirname(ckpt_id)
+        if os.path.isdir(final):
+            return final
+        staging = self._dirname(ckpt_id, staging=True)
+        return staging if os.path.isdir(staging) else None
+
+    @staticmethod
+    def load_manifest(ckpt_dir: str) -> Dict[str, Any]:
+        with open(os.path.join(ckpt_dir, MANIFEST)) as f:
+            return json.load(f)
+
+    @staticmethod
+    def load_blob(ckpt_dir: str, fname: str) -> Dict[str, Any]:
+        with open(os.path.join(ckpt_dir, fname), "rb") as f:
+            return pickle.load(f)
+
+    @classmethod
+    def resolve(cls, path: str) -> Tuple[int, str, Dict[str, Any]]:
+        """Resolve a restore target: either one checkpoint directory (has
+        a manifest) or a store root (picks the latest committed
+        checkpoint). Returns ``(ckpt_id, dir, manifest)``."""
+        from ..basic import WindFlowError
+
+        if os.path.exists(os.path.join(path, MANIFEST)):
+            manifest = cls.load_manifest(path)
+            return int(manifest["ckpt_id"]), path, manifest
+        store = cls(path)
+        cid = store.latest()
+        if cid is None:
+            raise WindFlowError(
+                f"restore_from={path!r}: no committed checkpoint found "
+                "(expected a checkpoint directory with a manifest.json or "
+                "a store root containing ckpt_* directories)")
+        d = store._dirname(cid)
+        return cid, d, cls.load_manifest(d)
+
+    def load_states(self, ckpt_dir: str, manifest: Dict[str, Any]
+                    ) -> Dict[Tuple[str, int], Any]:
+        """All replica states of one checkpoint, keyed (op name, idx)."""
+        out: Dict[Tuple[str, int], Any] = {}
+        for fname in manifest.get("blobs", []):
+            blob = self.load_blob(ckpt_dir, fname)
+            out[(blob["op"], int(blob["replica"]))] = blob["state"]
+        return out

@@ -53,6 +53,10 @@ class MemoryBroker:
         self._topics: Dict[str, List[List[KafkaMessage]]] = {}
         self._lock = threading.Lock()
         self._group_assign: Dict[Tuple[str, str], Dict[int, int]] = {}
+        # consumer-group committed offsets ((group, topic, partition) ->
+        # next offset) — written by MemoryTransport.commit_offsets when a
+        # checkpoint finalizes, mirroring a real broker's offset store
+        self.committed: Dict[Tuple[str, str, int], int] = {}
 
     @classmethod
     def get(cls, name: str, n_partitions: int = 4) -> "MemoryBroker":
@@ -142,8 +146,10 @@ class MemoryTransport:
         self._parts: List[Tuple[str, int]] = []
         self._pos: Dict[Tuple[str, int], int] = {}
         self._rr = 0
+        self._group = "windflow"
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
+        self._group = group
         if offsets:
             # explicit offsets = explicit assignment of ONLY the listed
             # partitions (identical semantics to the real transports)
@@ -178,6 +184,19 @@ class MemoryTransport:
     def close(self) -> None:
         pass
 
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_positions(self) -> Dict[Tuple[str, int], int]:
+        """Next-to-consume offset per assigned partition (the replayable
+        cursor a checkpoint records)."""
+        return dict(self._pos)
+
+    def commit_offsets(self, offsets: Dict[Tuple[str, int], int]) -> None:
+        """Group-offset commit on checkpoint finalize (at-least-once: a
+        restart WITHOUT a checkpoint resumes from these)."""
+        with self.broker._lock:
+            for (t, p), o in offsets.items():
+                self.broker.committed[(self._group, t, p)] = o
+
 
 def _member_share(offsets, member: int, n_members: int):
     """Deterministic split of explicitly-assigned partitions across the
@@ -202,13 +221,17 @@ class ConfluentTransport:
         self._consumer = None
         self._producer = None
         self._delivery_errors = 0
+        # checkpointing turns auto-commit OFF: offsets commit only when
+        # the coordinator finalizes a checkpoint (at-least-once end to
+        # end); KafkaSourceReplica flips this before subscribe
+        self.auto_commit = True
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
         ck = self._ck
         self._consumer = ck.Consumer({
             "bootstrap.servers": self.brokers,
             "group.id": group,
-            "enable.auto.commit": True,
+            "enable.auto.commit": self.auto_commit,
             "auto.offset.reset": "earliest",
         })
         if offsets:
@@ -284,6 +307,30 @@ class ConfluentTransport:
         if self._consumer is not None:
             self._consumer.close()
 
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_positions(self) -> Dict[Tuple[str, int], int]:
+        if self._consumer is None:
+            return {}
+        try:
+            tps = self._consumer.assignment()
+            return {(tp.topic, tp.partition): tp.offset
+                    for tp in self._consumer.position(tps)
+                    if tp.offset >= 0}
+        except Exception:
+            return {}
+
+    def commit_offsets(self, offsets: Dict[Tuple[str, int], int]) -> None:
+        if self._consumer is None or not offsets:
+            return
+        ck = self._ck
+        try:
+            self._consumer.commit(
+                offsets=[ck.TopicPartition(t, p, o)
+                         for (t, p), o in offsets.items()],
+                asynchronous=False)
+        except Exception:
+            pass  # best effort: a failed commit only widens the replay
+
 
 class KafkaPythonTransport:
     """kafka-python adapter (pure-python client). ``module`` injectable."""
@@ -295,12 +342,14 @@ class KafkaPythonTransport:
         self.brokers = brokers.split(",")
         self._consumer = None
         self._producer = None
+        self.auto_commit = True  # see ConfluentTransport
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
         kp = self._kp
         self._consumer = kp.KafkaConsumer(
             bootstrap_servers=self.brokers, group_id=group,
-            enable_auto_commit=True, auto_offset_reset="earliest")
+            enable_auto_commit=self.auto_commit,
+            auto_offset_reset="earliest")
         if offsets:
             mine = _member_share(offsets, member, n_members)
             if not mine:
@@ -340,6 +389,27 @@ class KafkaPythonTransport:
     def close(self) -> None:
         if self._consumer is not None:
             self._consumer.close()
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_positions(self) -> Dict[Tuple[str, int], int]:
+        if self._consumer is None:
+            return {}
+        try:
+            return {(tp.topic, tp.partition): self._consumer.position(tp)
+                    for tp in self._consumer.assignment()}
+        except Exception:
+            return {}
+
+    def commit_offsets(self, offsets: Dict[Tuple[str, int], int]) -> None:
+        if self._consumer is None or not offsets:
+            return
+        kp = self._kp
+        try:
+            self._consumer.commit(
+                {kp.TopicPartition(t, p): kp.OffsetAndMetadata(o, None)
+                 for (t, p), o in offsets.items()})
+        except Exception:
+            pass  # best effort: a failed commit only widens the replay
 
 
 def make_transport(brokers: str):
@@ -389,19 +459,116 @@ class Kafka_Source(BasicOperator):
 
 
 class KafkaSourceReplica(BasicReplica):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        # aligned checkpointing (windflow_tpu.checkpoint): barriers inject
+        # BETWEEN Kafka messages (never between the pushes of one deser
+        # call) so the snapshot offsets cover exactly the shipped prefix
+        self._coord = None
+        self._inject_cb = None
+        self._last_ckpt = 0
+        self._restore_offsets: Optional[Dict[Tuple[str, int], int]] = None
+        self._transport = None
+        # offsets captured at each injected barrier, committed to the
+        # broker only when the coordinator finalizes that checkpoint —
+        # from THIS thread (consumers are not thread-safe): the finalize
+        # listener only flips _commit_ready
+        self._pending_commits: Dict[int, Dict[Tuple[str, int], int]] = {}
+        self._commit_ready = 0
+        self._committed = 0
+
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Kafka_Source has no input")
+
+    # -- checkpointing -----------------------------------------------------
+    def bind_checkpoint(self, coordinator, inject_cb) -> None:
+        self._coord = coordinator
+        self._inject_cb = inject_cb
+        self._last_ckpt = coordinator.requested_id
+        coordinator.add_finalize_listener(self._on_finalized)
+
+    def request_checkpoint(self):
+        # injection happens at the consume loop's next message boundary
+        return None if self._coord is None \
+            else self._coord.trigger(force=True)
+
+    def _on_finalized(self, ckpt_id: int) -> None:
+        # runs on another worker's thread: only publish the watermark
+        if ckpt_id > self._commit_ready:
+            self._commit_ready = ckpt_id
+
+    def _maybe_inject(self) -> None:
+        from ..message import Barrier
+        cid = self._coord.requested_id
+        if cid > self._last_ckpt:
+            self._last_ckpt = cid
+            if self._transport is not None:
+                self._pending_commits[cid] = \
+                    self._transport.snapshot_positions()
+            self._inject_cb(Barrier(cid))
+
+    def final_checkpoint(self) -> None:
+        """Worker hook at consume-loop exit (see SourceReplica): inject a
+        pending epoch's barrier with the final offsets before EOS."""
+        if self._coord is not None and self._transport is not None:
+            if self._coord.requested_id != self._last_ckpt:
+                self._maybe_inject()
+            self._maybe_commit()
+
+    def _maybe_commit(self) -> None:
+        ready = self._commit_ready
+        if ready <= self._committed or self._transport is None:
+            return
+        best = max((c for c in self._pending_commits if c <= ready),
+                   default=None)
+        if best is not None:
+            self._transport.commit_offsets(self._pending_commits[best])
+            for c in [c for c in self._pending_commits if c <= best]:
+                del self._pending_commits[c]
+        self._committed = ready
+
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        if self._transport is not None:
+            # keys are (topic, partition) tuples — pickle keeps them
+            st["offsets"] = self._transport.snapshot_positions()
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        offs = state.get("offsets")
+        if offs is not None:
+            self._restore_offsets = dict(offs)
 
     def run_source(self) -> None:
         op = self.op
         transport = make_transport(op.brokers)
+        if self._coord is not None and hasattr(transport, "auto_commit"):
+            transport.auto_commit = False  # commits ride checkpoints only
+        self._transport = transport
+        offsets = op.offsets
+        if self._restore_offsets is not None:
+            # resume from the checkpoint's recorded positions. The
+            # snapshot was taken per replica AFTER the group share split,
+            # so it is already this member's slice — subscribe must not
+            # re-split it (member 0 of 1): same-parallelism restore maps
+            # replica idx -> its own recorded partitions
+            offsets = self._restore_offsets
+            member, n_members = 0, 1
+        else:
+            member, n_members = self.idx, op.parallelism
         try:
-            if not transport.subscribe(op.topics, op.group_id, self.idx,
-                                       op.parallelism, op.offsets):
+            if not transport.subscribe(op.topics, op.group_id, member,
+                                       n_members, offsets):
                 return
             self._consume_loop(transport)
         finally:
+            # the worker's final_checkpoint hook runs after run_source —
+            # too late for the transport; inject any pending epoch with
+            # the final offsets here, while the consumer is still open
+            self.final_checkpoint()
             transport.close()
+            self._transport = None
 
     def _consume_loop(self, transport) -> None:
         op = self.op
@@ -409,6 +576,10 @@ class KafkaSourceReplica(BasicReplica):
         idle_budget_us = op.idleness_ms * 1000
         last_progress = current_time_usecs()
         while True:
+            if self._coord is not None:
+                if self._coord.requested_id != self._last_ckpt:
+                    self._maybe_inject()
+                self._maybe_commit()
             msg = transport.consume()
             if msg is not None:
                 last_progress = current_time_usecs()

@@ -122,6 +122,35 @@ class Batch(StreamMsg):
         return f"<Batch n={len(self.rows)} wm={self.wm}>"
 
 
+class Barrier(StreamMsg):
+    """Aligned-checkpoint barrier (Chandy-Lamport marker, Flink-style).
+
+    Injected at sources by the ``CheckpointCoordinator`` and forwarded one
+    per producer->consumer edge (like EOS, unlike punctuations it is never
+    merged or reordered): every tuple sent before the barrier on a channel
+    belongs to checkpoint ``ckpt_id``, every tuple after it does not.
+    Multi-input workers align barriers per channel — buffering post-barrier
+    input from already-barriered channels — before snapshotting their
+    replica state (``runtime/worker.py`` + ``BarrierAligner`` in
+    ``runtime/collectors.py``). Barriers carry no payload and no watermark;
+    they never reach collectors or replicas (the worker consumes them)."""
+
+    __slots__ = ("ckpt_id", "stream_tag")
+
+    def __init__(self, ckpt_id: int, stream_tag: int = 0) -> None:
+        self.ckpt_id = ckpt_id
+        self.stream_tag = stream_tag
+
+    def min_watermark(self) -> int:
+        return 0
+
+    def copy_for_dest(self) -> "Barrier":
+        return Barrier(self.ckpt_id, self.stream_tag)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<Barrier ckpt={self.ckpt_id}>"
+
+
 class EOS:
     """End-of-stream sentinel (FastFlow EOS equivalent). One is sent per
     producer->consumer edge so consumers can count per-channel completion."""

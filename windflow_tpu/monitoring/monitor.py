@@ -147,6 +147,18 @@ _PROM_SCALARS = (
      "Queue_emit_fifo_depth_max", 1),
     ("windflow_worker_idle_ticks_total", "counter",
      "Worker idle-drain ticks", "Worker_idle_ticks", 1),
+    ("windflow_checkpoint_snapshots_total", "counter",
+     "Aligned checkpoint snapshots taken by the replica's worker",
+     "Checkpoint_snapshots", 1),
+    ("windflow_checkpoint_bytes_total", "counter",
+     "Checkpoint blob bytes written by the replica's worker",
+     "Checkpoint_bytes_total", 1),
+    ("windflow_checkpoint_snapshot_seconds_total", "counter",
+     "Time spent capturing checkpoint snapshots",
+     "Checkpoint_snapshot_usec_total", 1e-6),
+    ("windflow_checkpoint_align_stall_seconds_total", "counter",
+     "Time multi-input workers stalled aligning checkpoint barriers",
+     "Checkpoint_align_stall_usec_total", 1e-6),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -207,6 +219,20 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                      "by reordering collectors")
         lines.append("# TYPE windflow_dropped_tuples_total counter")
         lines.extend(drop_body)
+    ckpt_body = []
+    for graph, st in reports.items():
+        ck = st.get("Checkpoints") if isinstance(st, dict) else None
+        if isinstance(ck, dict) and isinstance(
+                ck.get("Checkpoints_completed"), (int, float)):
+            ckpt_body.append(
+                f'windflow_checkpoints_completed_total'
+                f'{{graph="{_prom_escape(graph)}"}} '
+                f'{ck["Checkpoints_completed"]:g}')
+    if ckpt_body:
+        lines.append("# HELP windflow_checkpoints_completed_total Aligned "
+                     "checkpoints committed by the coordinator")
+        lines.append("# TYPE windflow_checkpoints_completed_total counter")
+        lines.extend(ckpt_body)
     # merged per-operator histograms
     for fam, help_, field in _PROM_HISTS:
         body = []

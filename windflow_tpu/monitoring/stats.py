@@ -34,6 +34,12 @@ class StatsRecord:
         "dispatch_host_prep_us", "dispatch_commit_us",
         "dispatch_host_prep_total_us", "dispatch_commit_total_us",
         "dispatch_batches", "dispatch_stalls", "dispatch_depth_max",
+        # aligned-barrier checkpointing (windflow_tpu.checkpoint):
+        # per-replica snapshot count/duration/size + barrier-alignment
+        # stall time (multi-input workers buffering behind the barrier)
+        "checkpoints_taken", "checkpoint_snapshot_total_us",
+        "checkpoint_last_snapshot_us", "checkpoint_bytes_total",
+        "checkpoint_align_total_us",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -77,6 +83,11 @@ class StatsRecord:
         self.dispatch_batches = 0
         self.dispatch_stalls = 0  # forced ordering-point drains
         self.dispatch_depth_max = 0
+        self.checkpoints_taken = 0
+        self.checkpoint_snapshot_total_us = 0.0
+        self.checkpoint_last_snapshot_us = 0.0
+        self.checkpoint_bytes_total = 0
+        self.checkpoint_align_total_us = 0.0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -155,6 +166,18 @@ class StatsRecord:
     def note_dispatch_stall(self) -> None:
         self.dispatch_stalls += 1
 
+    # -- checkpointing (windflow_tpu.checkpoint) -----------------------------
+    def note_checkpoint(self, snapshot_us: float, nbytes: int,
+                        align_us: float) -> None:
+        """One aligned snapshot of this replica's worker chain:
+        state-capture duration, blob bytes written, and how long barrier
+        alignment stalled the chain (0 for single-input workers)."""
+        self.checkpoints_taken += 1
+        self.checkpoint_snapshot_total_us += snapshot_us
+        self.checkpoint_last_snapshot_us = snapshot_us
+        self.checkpoint_bytes_total += nbytes
+        self.checkpoint_align_total_us += align_us
+
     # -- latency tracing -----------------------------------------------------
     def note_e2e(self, us: float) -> None:
         """End-to-end latency of one traced tuple (sink side)."""
@@ -197,6 +220,14 @@ class StatsRecord:
             "Dispatch_batches": self.dispatch_batches,
             "Dispatch_readback_stalls": self.dispatch_stalls,
             "Dispatch_queue_depth_max": self.dispatch_depth_max,
+            "Checkpoint_snapshots": self.checkpoints_taken,
+            "Checkpoint_snapshot_usec_total": round(
+                self.checkpoint_snapshot_total_us, 1),
+            "Checkpoint_last_snapshot_usec": round(
+                self.checkpoint_last_snapshot_us, 1),
+            "Checkpoint_bytes_total": self.checkpoint_bytes_total,
+            "Checkpoint_align_stall_usec_total": round(
+                self.checkpoint_align_total_us, 1),
             "isTerminated": self.is_terminated,
         }
         # -- queue / backpressure plane (0s for sources and fused chains) ---

@@ -188,6 +188,20 @@ class BasicReplica:
     def flush_on_termination(self) -> None:
         """Emit pending state at EOS (window operators override)."""
 
+    # -- checkpointing (aligned snapshots, windflow_tpu.checkpoint) ----------
+    def snapshot_state(self) -> dict:
+        """Return this replica's complete processing state as a picklable
+        dict. Called on the replica's own worker thread at an aligned
+        barrier (no tuple in flight; device dispatch queues drained, so
+        subclasses may ``jax.device_get`` their device state directly).
+        Stateful subclasses extend the base dict via ``super()``."""
+        return {"cur_wm": self.cur_wm}
+
+    def restore_state(self, state: dict) -> None:
+        """Inverse of ``snapshot_state``; called after ``build_replicas``
+        (emitter/collector wiring done) and before any worker starts."""
+        self.cur_wm = state.get("cur_wm", 0)
+
     def terminate(self) -> None:
         if self.terminated:
             return

@@ -166,6 +166,16 @@ class ReduceReplica(BasicReplica):
         self.key_state[key] = state
         self.emitter.emit(copy.copy(state), ts, wm)
 
+    # -- checkpointing -------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["key_state"] = self.key_state
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self.key_state = dict(state.get("key_state", {}))
+
 
 # --------------------------------------------------------------------------
 # Sink

@@ -176,6 +176,40 @@ class FfatReplica(BasicReplica):
                 self._advance_tb(key, ks, 0, self.cur_wm)
         super().on_punctuation(wm)
 
+    # -- checkpointing -----------------------------------------------------
+    # The FlatFAT ring holds the user's combine callable, which must stay
+    # out of the pickle: snapshot the pure data (tree slots, head, size)
+    # and re-attach the operator's combine on restore.
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["ignored"] = self.ignored
+        st["keys"] = {
+            key: {"count": ks.count, "next_gwid": ks.next_gwid,
+                  "pending_panes": dict(ks.pending_panes),
+                  "next_pane_to_push": ks.next_pane_to_push,
+                  "fat": (ks.fat.capacity, ks.fat.head, ks.fat.size,
+                          list(ks.fat.tree))}
+            for key, ks in self.keys.items()}
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self.ignored = state.get("ignored", 0)
+        self.keys = {}
+        for key, d in state.get("keys", {}).items():
+            ks = _FfatKeyState()
+            cap, head, size, tree = d["fat"]
+            fat = FlatFAT(cap, self.op.combine)
+            fat.tree = list(tree)
+            fat.head = head
+            fat.size = size
+            ks.fat = fat
+            ks.count = d["count"]
+            ks.next_gwid = d["next_gwid"]
+            ks.pending_panes = dict(d["pending_panes"])
+            ks.next_pane_to_push = d["next_pane_to_push"]
+            self.keys[key] = ks
+
     def flush_on_termination(self) -> None:
         op = self.op
         for key, ks in self.keys.items():

@@ -142,3 +142,23 @@ class IntervalJoinReplica(BasicReplica):
             if k:
                 del ts_list[:k]
                 del ka.rows[side][:k]
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["keys"] = {
+            key: {"ts": (list(ka.ts[0]), list(ka.ts[1])),
+                  "rows": (list(ka.rows[0]), list(ka.rows[1])),
+                  "counters": list(ka.counters)}
+            for key, ka in self.keys.items()}
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self.keys = {}
+        for key, d in state.get("keys", {}).items():
+            ka = _KeyArchives()
+            ka.ts = (list(d["ts"][0]), list(d["ts"][1]))
+            ka.rows = (list(d["rows"][0]), list(d["rows"][1]))
+            ka.counters = list(d["counters"])
+            self.keys[key] = ka

@@ -81,6 +81,14 @@ class SourceShipper:
             wm = self._next_wm
         self._r.ship_columns(cols, ts_arr, wm)
 
+    # -- checkpointing -----------------------------------------------------
+    def request_checkpoint(self) -> Optional[int]:
+        """Force an aligned checkpoint NOW (at this tuple boundary) instead
+        of waiting for the coordinator's interval — the deterministic
+        trigger used by tests and drain-style shutdowns. Returns the new
+        checkpoint id, or None when checkpointing is not enabled."""
+        return self._r.request_checkpoint()
+
     # convenience used by generators/tests
     @property
     def current_watermark(self) -> int:
@@ -114,20 +122,93 @@ class SourceReplica(BasicReplica):
         # ``inputs_received & mask`` zero, so the hot path costs the
         # same with tracing off or sampling 1/64
         self._trace_mask = self.stats.sample_every - 1
+        # aligned checkpointing (windflow_tpu.checkpoint): the coordinator
+        # bumps an epoch; we notice at the next tuple boundary, snapshot
+        # our replay position and inject the barrier downstream
+        self._coord = None
+        self._inject_cb = None  # Worker.checkpoint_now (chain-wide)
+        self._last_ckpt = 0
+        self._restore_position = None
 
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Source has no input")
+
+    # -- checkpointing -----------------------------------------------------
+    def bind_checkpoint(self, coordinator, inject_cb) -> None:
+        """Wired by the source Worker when checkpointing is enabled."""
+        self._coord = coordinator
+        self._inject_cb = inject_cb
+        self._last_ckpt = coordinator.requested_id
+
+    def request_checkpoint(self):
+        if self._coord is None:
+            return None
+        cid = self._coord.trigger(force=True)
+        self._maybe_inject()
+        return cid
+
+    def _maybe_inject(self) -> None:
+        from ..message import Barrier
+        cid = self._coord.requested_id
+        if cid > self._last_ckpt:
+            self._last_ckpt = cid
+            self._inject_cb(Barrier(cid))
+
+    def final_checkpoint(self) -> None:
+        """Called by the worker when the generation loop ends, before the
+        EOS cascade: an epoch opened while we were finishing still gets
+        this source's barrier + (final) position snapshot."""
+        if self._coord is not None:
+            self._maybe_inject()
+
+    def snapshot_state(self) -> dict:
+        """Base state + the functor's replay position when it speaks the
+        replayable protocol: ``snapshot_position([ctx])`` returning any
+        picklable cursor, and ``restore(position[, ctx])`` on restart.
+        The position must describe exactly the tuples pushed so far —
+        barriers inject at push boundaries, so a one-tuple-per-increment
+        cursor gives exact resume; coarser cursors give at-least-once."""
+        st = super().snapshot_state()
+        st["shipped"] = self.stats.inputs_received
+        snap = getattr(self.op.func, "snapshot_position", None)
+        if snap is not None:
+            st["position"] = (snap(self.context) if arity(snap) >= 1
+                              else snap())
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self._restore_position = state.get("position")
+        self.stats.inputs_received = state.get("shipped", 0)
 
     def run_source(self) -> None:
         """Run the user generation loop to completion (then the worker
         triggers the EOS cascade, ``wf/source.hpp:114-129``)."""
         shipper = SourceShipper(self)
+        if self._restore_position is not None:
+            restore = getattr(self.op.func, "restore", None)
+            if restore is None:
+                raise WindFlowError(
+                    f"{self.op.name}: checkpoint restore needs a replayable "
+                    "source functor (snapshot_position()/restore(position)); "
+                    "this one has no restore()")
+            if arity(restore) >= 2:
+                restore(self._restore_position, self.context)
+            else:
+                restore(self._restore_position)
         if self.op._riched:
             self.op.func(shipper, self.context)
         else:
             self.op.func(shipper)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
+        # barrier BEFORE the tuple: the functor's cursor has not advanced
+        # past the tuple being pushed (the natural ``v = pos; push(v);
+        # pos += 1`` style), so the snapshot position covers exactly the
+        # tuples already emitted and the in-flight one replays post-restore
+        if self._coord is not None \
+                and self._coord.requested_id != self._last_ckpt:
+            self._maybe_inject()
         if wm > self.cur_wm:
             self.cur_wm = wm
         st = self.stats
@@ -137,6 +218,9 @@ class SourceReplica(BasicReplica):
         self.emitter.emit(payload, ts, self.cur_wm)
 
     def ship_columns(self, cols, ts_arr, wm: int) -> None:
+        if self._coord is not None \
+                and self._coord.requested_id != self._last_ckpt:
+            self._maybe_inject()  # before the push, like ship()
         if wm > self.cur_wm:
             self.cur_wm = wm
         self.stats.inputs_received += len(ts_arr)

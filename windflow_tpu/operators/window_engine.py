@@ -288,3 +288,22 @@ class WindowEngine:
             for w in kd.wins:
                 self._fire(key, kd, w, self.cur_wm, self.cur_wm, emit)
             kd.wins.clear()
+
+    # ------------------------------------------------------------------
+    # checkpointing: the engine's state is pure data (_KeyDesc trees of
+    # open windows, archives, counters) — functors/context stay out of
+    # the blob and come from the rebuilt operator on restore
+    def snapshot_state(self) -> dict:
+        return {"key_map": dict(self.key_map.items()),
+                "ignored_tuples": self.ignored_tuples,
+                "cur_wm": self.cur_wm}
+
+    def restore_state(self, state: dict) -> None:
+        km = state.get("key_map", {})
+        if isinstance(self.key_map, dict):
+            self.key_map = dict(km)
+        else:  # cache-backed store (P_Keyed_Windows): write through
+            for k, v in km.items():
+                self.key_map[k] = v
+        self.ignored_tuples = state.get("ignored_tuples", 0)
+        self.cur_wm = state.get("cur_wm", 0)

@@ -57,6 +57,18 @@ class _WindowReplica(BasicReplica):
         self.engine.flush(self._emit_cb)
         self.stats.inputs_ignored += self.engine.ignored_tuples
 
+    # -- checkpointing -------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["engine"] = self.engine.snapshot_state()
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        eng = state.get("engine")
+        if eng is not None:
+            self.engine.restore_state(eng)
+
 
 class _WindowOperatorBase(BasicOperator):
     op_type = OpType.WIN

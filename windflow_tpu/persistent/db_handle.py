@@ -36,6 +36,8 @@ class DBHandle:
                  deserialize: Optional[Callable[[bytes], Any]] = None,
                  db_dir: Optional[str] = None,
                  shared: bool = False) -> None:
+        if db_dir is not None:
+            os.makedirs(db_dir, exist_ok=True)
         self.path = os.path.join(db_dir or default_db_dir(), f"{name}.db")
         self._ser = serialize or pickle.dumps
         self._de = deserialize or pickle.loads
@@ -83,8 +85,77 @@ class DBHandle:
         return self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
 
     def commit(self) -> None:
+        """Durable, atomic commit of all pending puts/deletes.
+
+        The transaction itself was always atomic (sqlite journal), but the
+        original in-place flow left committed rows in the ``-wal`` side
+        file until some later automatic checkpoint: a crash that lost or
+        orphaned the WAL (or any backup/copy of just the ``.db`` file)
+        silently dropped the last commits. ``commit()`` now folds the WAL
+        into the main database through sqlite's atomic checkpoint
+        protocol, so after it returns the ``.db`` file alone is a
+        complete, self-contained image of the committed state."""
         self._conn.commit()
+        try:
+            self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        except sqlite3.DatabaseError:  # pragma: no cover - locked reader
+            pass
 
     def close(self) -> None:
-        self._conn.commit()
+        self.commit()
         self._conn.close()
+
+    # -- checkpointing (windflow_tpu.checkpoint) ---------------------------
+    def snapshot_bytes(self) -> bytes:
+        """Consistent point-in-time image of the whole database (sqlite
+        online backup of the live connection), as bytes for a checkpoint
+        blob. Pending writes are committed first."""
+        self._conn.commit()
+        fd, tmp = tempfile.mkstemp(suffix=".snap",
+                                   dir=os.path.dirname(self.path) or ".")
+        os.close(fd)
+        try:
+            dst = sqlite3.connect(tmp)
+            try:
+                self._conn.backup(dst)
+            finally:
+                dst.close()
+            with open(tmp, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(tmp)
+
+    def restore_bytes(self, data: bytes) -> None:
+        """Replace the database's entire contents with a ``snapshot_bytes``
+        image (crash recovery: the on-disk file may hold post-checkpoint
+        writes from the crashed run). Staged via temp file + atomic rename
+        so a crash mid-restore cannot leave a torn image behind."""
+        tmp = self.path + ".restore.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        final = self.path + ".restore"
+        os.replace(tmp, final)
+        # the backup destination must hold no open transaction
+        self._conn.commit()
+        try:
+            src = sqlite3.connect(final)
+            try:
+                src.backup(self._conn)
+            finally:
+                src.close()
+            self.commit()
+        finally:
+            os.unlink(final)
+
+    def export_to(self, path: str) -> None:
+        """Write a standalone copy of the database to ``path`` via temp
+        file + atomic rename: readers of ``path`` see either the previous
+        complete export or the new one, never a torn file."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(self.snapshot_bytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

@@ -84,6 +84,23 @@ class _PersistentReplica(BasicReplica):
         super().terminate()
         self.db.close()
 
+    # -- checkpointing -----------------------------------------------------
+    # Keyed state lives in cache+DB; spill the cache and snapshot the DB
+    # file as one consistent image. Restore REPLACES the on-disk contents:
+    # after a crash the file holds post-checkpoint writes that must roll
+    # back to the barrier point.
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        self.state.flush()
+        st["db"] = self.db.snapshot_bytes()
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        blob = state.get("db")
+        if blob is not None:
+            self.db.restore_bytes(blob)
+
 
 # ---------------------------------------------------------------------------
 class P_Map(_PersistentOperator):

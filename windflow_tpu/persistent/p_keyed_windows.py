@@ -55,3 +55,27 @@ class PKeyedWindowsReplica(_WindowReplica):
         super().flush_on_termination()
         self.engine.key_map.flush()
         self.db.close()
+
+    # -- checkpointing -----------------------------------------------------
+    # The engine's key map is the cache-backed store: spill it and ship
+    # the DB image instead of materializing every cold key into the blob.
+    # Restore replaces the DB contents (a crashed run's file holds
+    # post-checkpoint descriptors that must roll back).
+    def snapshot_state(self) -> dict:
+        from ..operators.base import BasicReplica
+        st = BasicReplica.snapshot_state(self)
+        self.engine.key_map.flush()
+        st["db"] = self.db.snapshot_bytes()
+        st["engine_meta"] = {"ignored_tuples": self.engine.ignored_tuples,
+                             "cur_wm": self.engine.cur_wm}
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        from ..operators.base import BasicReplica
+        BasicReplica.restore_state(self, state)
+        blob = state.get("db")
+        if blob is not None:
+            self.db.restore_bytes(blob)
+        meta = state.get("engine_meta", {})
+        self.engine.ignored_tuples = meta.get("ignored_tuples", 0)
+        self.engine.cur_wm = meta.get("cur_wm", 0)

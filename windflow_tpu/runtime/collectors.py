@@ -46,6 +46,64 @@ class AtomicCounter:
             return self._v
 
 
+class BarrierAligner:
+    """Per-worker aligned-checkpoint barrier bookkeeping (Flink's barrier
+    alignment; the checkpoint twin of the per-channel EOS counting in
+    ``Worker._process``).
+
+    A checkpoint's barrier arrives once per input channel. The first
+    arrival opens an alignment: from then on, input from channels that
+    already delivered their barrier is BUFFERED (those tuples are
+    post-barrier and must not reach the snapshot), while the remaining
+    channels keep processing. When every live channel has delivered the
+    barrier — or gone EOS, a finished producer sends no more data — the
+    worker snapshots and the buffered backlog replays in arrival order.
+    Buffering (instead of blocking the channel) means alignment can never
+    deadlock the bounded channels upstream."""
+
+    __slots__ = ("live", "waiting", "arrived", "buffered", "align_t0_ns")
+
+    def __init__(self, n_channels: int) -> None:
+        self.live = set(range(n_channels))
+        self.waiting: Optional[Any] = None  # the in-flight Barrier
+        self.arrived: set = set()
+        self.buffered: list = []  # (ch, msg) from already-barriered channels
+        self.align_t0_ns = 0
+
+    def blocked(self, ch: int) -> bool:
+        return self.waiting is not None and ch in self.arrived
+
+    def on_barrier(self, ch: int, barrier: Any) -> bool:
+        """Returns True when alignment is complete (snapshot now)."""
+        import time
+        if self.waiting is None:
+            self.waiting = barrier
+            self.arrived = {ch}
+            self.align_t0_ns = time.monotonic_ns()
+        else:
+            self.arrived.add(ch)
+        return self.live.issubset(self.arrived)
+
+    def on_eos(self, ch: int) -> bool:
+        """A closed channel sends no more data (all of its input was
+        pre-barrier); it stops counting toward alignment. Returns True
+        when this completes a pending alignment."""
+        self.live.discard(ch)
+        return (self.waiting is not None
+                and self.live.issubset(self.arrived))
+
+    def take(self):
+        """Close the alignment: ``(barrier, stall_us, buffered)``."""
+        import time
+        barrier = self.waiting
+        stall_us = (time.monotonic_ns() - self.align_t0_ns) / 1e3
+        buffered = self.buffered
+        self.waiting = None
+        self.arrived = set()
+        self.buffered = []
+        return barrier, stall_us, buffered
+
+
 class BasicCollector:
     """Chain-node protocol: handle_msg(ch, msg) / on_channel_eos(ch) /
     terminate(). ``next_node`` is the stage's first replica."""
@@ -70,6 +128,17 @@ class BasicCollector:
     def terminate(self) -> None:
         pass
 
+    # -- checkpointing (aligned snapshots, windflow_tpu.checkpoint) --------
+    # Collectors buffer pre-barrier messages the replica has not seen yet
+    # (ordering/K-slack heaps, id sequencing), so their buffers are part
+    # of the worker's snapshot. ``live`` is NOT snapshotted: restore
+    # rebuilds the topology with every channel open and sources replay.
+    def snapshot_state(self) -> dict:
+        return {}
+
+    def restore_state(self, state: dict) -> None:
+        pass
+
 
 class WatermarkCollector(BasicCollector):
     def __init__(self, n_channels: int, next_node: Any,
@@ -89,6 +158,14 @@ class WatermarkCollector(BasicCollector):
         self._tag(ch, msg)
         msg.wm = self._out_wm()
         self.next_node.handle_msg(0, msg)
+
+    def snapshot_state(self) -> dict:
+        return {"ch_wm": list(self._ch_wm)}
+
+    def restore_state(self, state: dict) -> None:
+        wm = state.get("ch_wm")
+        if wm is not None and len(wm) == len(self._ch_wm):
+            self._ch_wm = list(wm)
 
 
 class OrderingCollector(BasicCollector):
@@ -152,6 +229,14 @@ class OrderingCollector(BasicCollector):
             self.next_node.handle_msg(0, m)
         self._bufs = [deque() for _ in range(self.n_channels)]
 
+    def snapshot_state(self) -> dict:
+        return {"bufs": [list(b) for b in self._bufs]}
+
+    def restore_state(self, state: dict) -> None:
+        bufs = state.get("bufs")
+        if bufs is not None and len(bufs) == len(self._bufs):
+            self._bufs = [deque(b) for b in bufs]
+
 
 class IDSequencerCollector(BasicCollector):
     """Per-key id sequencer in front of WLQ/REDUCE window stages (used in
@@ -196,6 +281,15 @@ class IDSequencerCollector(BasicCollector):
             for i in sorted(pend):
                 self.next_node.handle_msg(0, pend[i])
         self._pending.clear()
+
+    def snapshot_state(self) -> dict:
+        return {"next": dict(self._next),
+                "pending": {k: dict(v) for k, v in self._pending.items()}}
+
+    def restore_state(self, state: dict) -> None:
+        self._next = dict(state.get("next", {}))
+        self._pending = {k: dict(v)
+                         for k, v in state.get("pending", {}).items()}
 
 
 class DPJoinCollector(BasicCollector):
@@ -262,6 +356,16 @@ class DPJoinCollector(BasicCollector):
             _, _, _, m = heapq.heappop(self._heap)
             self.next_node.handle_msg(0, m)
 
+    def snapshot_state(self) -> dict:
+        return {"ch_wm": list(self._ch_wm), "heap": list(self._heap)}
+
+    def restore_state(self, state: dict) -> None:
+        wm = state.get("ch_wm")
+        if wm is not None and len(wm) == len(self._ch_wm):
+            self._ch_wm = list(wm)
+        self._heap = list(state.get("heap", []))
+        heapq.heapify(self._heap)
+
 
 class KSlackCollector(BasicCollector):
     """Adaptive K-slack (``wf/kslack_collector.hpp:99-118``): K tracks the
@@ -316,3 +420,16 @@ class KSlackCollector(BasicCollector):
 
     def terminate(self) -> None:
         self._release(MAX_WM)
+
+    def snapshot_state(self) -> dict:
+        return {"K": self.K, "max_ts": self._max_ts,
+                "frontier": self._frontier, "heap": list(self._heap),
+                "seq": self._seq}
+
+    def restore_state(self, state: dict) -> None:
+        self.K = state.get("K", 0)
+        self._max_ts = state.get("max_ts", 0)
+        self._frontier = state.get("frontier", -1)
+        self._seq = state.get("seq", 0)
+        self._heap = list(state.get("heap", []))
+        heapq.heapify(self._heap)

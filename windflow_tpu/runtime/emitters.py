@@ -134,9 +134,38 @@ class BasicEmitter:
         for port in self.ports:
             port.send_eos()
 
+    def send_barrier_all(self, barrier) -> None:
+        """Checkpoint-barrier propagation: flush partial batches FIRST so
+        every already-emitted tuple stays pre-barrier on its channel, then
+        send the barrier on every edge (one per port, like EOS — never
+        batched, never reordered)."""
+        self.flush()
+        for port in self.ports:
+            port.send(barrier.copy_for_dest())
+
     def eos_ports(self) -> Sequence[Port]:
         """All queue ports (for emergency EOS propagation on worker error)."""
         return self.ports
+
+    # -- checkpointing: routing counters travel with the replica blob ------
+    # (per-destination ids keep DETERMINISTIC-mode collectors' monotonic-id
+    # contract across a restore; the round-robin cursor keeps FORWARD
+    # placement deterministic)
+    def emitter_state(self) -> dict:
+        st = {"next_ids": list(self._next_ids),
+              "emit_count": self._emit_count}
+        rr = getattr(self, "_rr", None)
+        if rr is not None:
+            st["rr"] = rr
+        return st
+
+    def restore_emitter_state(self, state: dict) -> None:
+        ids = state.get("next_ids")
+        if ids is not None and len(ids) == len(self._next_ids):
+            self._next_ids = list(ids)
+        self._emit_count = state.get("emit_count", 0)
+        if "rr" in state and hasattr(self, "_rr"):
+            self._rr = state["rr"]
 
 
 class ForwardEmitter(BasicEmitter):
@@ -318,8 +347,20 @@ class SplittingEmitter(BasicEmitter):
         for e in self.inner:
             e.send_eos_all()
 
+    def send_barrier_all(self, barrier) -> None:
+        for e in self.inner:
+            e.send_barrier_all(barrier)
+
     def eos_ports(self):
         return [p for e in self.inner for p in e.eos_ports()]
+
+    def emitter_state(self) -> dict:
+        return {"inner": [e.emitter_state() for e in self.inner]}
+
+    def restore_emitter_state(self, state: dict) -> None:
+        inner = state.get("inner", [])
+        for e, st in zip(self.inner, inner):
+            e.restore_emitter_state(st)
 
 
 class NullEmitter(BasicEmitter):

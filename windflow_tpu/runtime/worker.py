@@ -7,6 +7,16 @@ the first replica. Termination mirrors the reference's EOS cascade: sources
 finish their loop, EOS flows per-edge, each replica flushes windows/partial
 batches on the way down (``wf/basic_operator.hpp:180-189``).
 
+Checkpointing (no reference analog — ``windflow_tpu.checkpoint``): the
+worker is also the alignment point for checkpoint barriers. ``Barrier``
+messages ride the channels like EOS (one per producer edge, intercepted
+here, never delivered to collectors/replicas); a ``BarrierAligner`` buffers
+post-barrier input from already-barriered channels until every live channel
+delivered the barrier, then ``checkpoint_now`` drains the chain's device
+dispatch queues, flushes partial output batches, forwards the barrier
+downstream, snapshots every fused node (collector included) and acks the
+coordinator with the blobs.
+
 Error handling is stricter than the reference (which prints and
 ``exit(EXIT_FAILURE)``): a replica that throws records the error, drains its
 inputs, and force-propagates EOS downstream so the whole graph unwinds and
@@ -16,10 +26,12 @@ inputs, and force-propagates EOS downstream so the whole graph unwinds and
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, List, Optional
 
-from ..message import EOS
+from ..message import EOS, Barrier
 from .channel import Channel
+from .collectors import BarrierAligner
 
 
 class Worker(threading.Thread):
@@ -30,16 +42,31 @@ class Worker(threading.Thread):
     """
 
     def __init__(self, wname: str, chain: List[Any],
-                 channel: Optional[Channel] = None) -> None:
+                 channel: Optional[Channel] = None,
+                 coordinator: Optional[Any] = None) -> None:
         super().__init__(name=wname, daemon=True)
         self.chain = chain
         self.channel = channel
+        self.coordinator = coordinator  # CheckpointCoordinator or None
         self.error: Optional[BaseException] = None
         self._eos_seen = 0
+        self._has_coll = hasattr(chain[0], "on_channel_eos")
+        # replicas = chain nodes that carry operator state (the collector,
+        # when present, is snapshotted alongside the first replica)
+        self._replicas = [n for n in chain if hasattr(n, "snapshot_state")
+                          and hasattr(n, "op")]
+        self._aligner: Optional[BarrierAligner] = None
+        if coordinator is not None and channel is None and chain:
+            # source chain: the source replica injects barriers at tuple
+            # boundaries and hands the chain snapshot back to us
+            bind = getattr(chain[0], "bind_checkpoint", None)
+            if bind is not None:
+                bind(coordinator, self.checkpoint_now)
 
     def run(self) -> None:
         try:
             self._process()
+            self._retire()
             self._shutdown()
         except BaseException as e:
             self.error = e
@@ -59,9 +86,17 @@ class Worker(threading.Thread):
         head = self.chain[0]
         if self.channel is None:
             head.run_source()
+            # a pending epoch the loop never reached injects at EOS time:
+            # a finished source's final position is a valid snapshot
+            # (restore resumes it as already-complete), and without it the
+            # checkpoint could never gather all acks
+            fin = getattr(head, "final_checkpoint", None)
+            if fin is not None:
+                fin()
             return
         n_inputs = self.channel.n_inputs
-        has_coll = hasattr(head, "on_channel_eos")
+        if self.coordinator is not None:
+            self._aligner = BarrierAligner(n_inputs)
         # anything that pipelines work (replica dispatch queues, emitter
         # D2H FIFOs) must not withhold results forever on an idle stream:
         # poll with a timeout and give it an idle tick when the channel
@@ -89,8 +124,7 @@ class Worker(threading.Thread):
         idle_streak = 0
         # idle ticks are observability too: attribute them to the first
         # chain node that owns a StatsRecord (Worker_idle_ticks)
-        stats = next((n.stats for n in self.chain
-                      if getattr(n, "stats", None) is not None), None)
+        stats = self._stats()
         while self._eos_seen < n_inputs:
             backoff = idle_s if idle_s is None else idle_s * min(
                 16, 1 << min(idle_streak, 4))
@@ -104,13 +138,105 @@ class Worker(threading.Thread):
                 idle_streak = 0 if did_work else idle_streak + 1
                 continue
             idle_streak = 0
-            ch, msg = item
-            if isinstance(msg, EOS):
-                self._eos_seen += 1
-                if has_coll:
-                    head.on_channel_eos(ch)
-                continue
-            head.handle_msg(ch, msg)
+            self._handle_item(item[0], item[1])
+
+    def _handle_item(self, ch: int, msg: Any) -> None:
+        """One channel delivery: barrier alignment first, then the normal
+        EOS / message path. Re-entered for buffered post-barrier items
+        after a snapshot (a buffered item may itself be the next Barrier,
+        opening the next alignment)."""
+        al = self._aligner
+        if al is not None and al.blocked(ch):
+            # post-barrier input on an aligned channel: park it. EOS too
+            # (consuming it early would mutate collector state
+            # mid-snapshot), and so is a next-epoch Barrier — channels are
+            # FIFO, so anything behind the current epoch's barrier belongs
+            # to the next alignment and replays after the snapshot.
+            al.buffered.append((ch, msg))
+            return
+        if isinstance(msg, Barrier):
+            if al is not None and al.on_barrier(ch, msg):
+                self._complete_alignment()
+            return  # checkpointing off: stray barriers are dropped
+        if isinstance(msg, EOS):
+            self._eos_seen += 1
+            if self._has_coll:
+                self.chain[0].on_channel_eos(ch)
+            if al is not None and al.on_eos(ch):
+                self._complete_alignment()
+            return
+        self.chain[0].handle_msg(ch, msg)
+
+    def _complete_alignment(self) -> None:
+        barrier, stall_us, buffered = self._aligner.take()
+        self.checkpoint_now(barrier, stall_us)
+        for ch, msg in buffered:
+            self._handle_item(ch, msg)
+
+    # -- checkpointing -----------------------------------------------------
+    def _stats(self):
+        return next((n.stats for n in self.chain
+                     if getattr(n, "stats", None) is not None), None)
+
+    def checkpoint_now(self, barrier: Barrier, stall_us: float = 0.0) -> None:
+        """Snapshot the whole chain for one aligned barrier. Runs on this
+        worker's own thread (from ``_complete_alignment``, or from the
+        source replica's injection hook mid-``run_source``), so no tuple
+        is in flight anywhere in the chain.
+
+        Order matters: (1) chain-ordered drain of each node's device
+        dispatch queue + flush of its emitter, so every pre-barrier tuple
+        lands in downstream channels (or fused successors) BEFORE the
+        barrier; (2) barrier downstream via the last emitter (which
+        flushes again first); (3) state capture; (4) ack with blobs —
+        the coordinator commits once every worker acked."""
+        coord = self.coordinator
+        if coord is None:
+            return
+        t0 = time.perf_counter()
+        replicas = self._replicas
+        last = replicas[-1] if replicas else None
+        for node in replicas:
+            dq = getattr(node, "dispatch", None)
+            if dq is not None:
+                dq.drain(forced=True)
+            em = node.emitter
+            if em is not None and node is not last:
+                em.flush()  # inline edge: feeds the next fused node now
+        if last is not None and last.emitter is not None:
+            last.emitter.send_barrier_all(barrier)
+        nbytes = coord.ack(barrier.ckpt_id, self.name,
+                           self._capture_blobs())
+        stats = self._stats()
+        if stats is not None:
+            stats.note_checkpoint((time.perf_counter() - t0) * 1e6,
+                                  nbytes, stall_us)
+
+    def _capture_blobs(self) -> dict:
+        blobs = {}
+        for node in self._replicas:
+            dq = getattr(node, "dispatch", None)
+            if dq is not None:
+                dq.drain(forced=True)
+            state = node.snapshot_state()
+            if node.emitter is not None:
+                state["__emitter__"] = node.emitter.emitter_state()
+            blobs[(node.op.name, node.idx)] = state
+        if self._has_coll and self._replicas:
+            coll_state = self.chain[0].snapshot_state()
+            if coll_state:
+                blobs[(self._replicas[0].op.name,
+                       self._replicas[0].idx)]["__collector__"] = coll_state
+        return blobs
+
+    def _retire(self) -> None:
+        """Clean exit with checkpointing on: hand the coordinator our
+        final state so epochs opened after we finish still complete (a
+        finished worker's state is frozen — captured BEFORE the EOS
+        flush, so a restore re-runs the flush exactly like a live
+        replica would)."""
+        if self.coordinator is not None:
+            self.coordinator.retire(self.name, self._capture_blobs())
 
     def _shutdown(self) -> None:
         # EOS cascade: terminate in chain order so that anything emitted by

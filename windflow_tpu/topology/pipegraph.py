@@ -50,6 +50,122 @@ class PipeGraph:
         self._started = False
         self._ended = False
         self._monitor = None
+        # aligned-barrier checkpointing (windflow_tpu.checkpoint):
+        # enabled via with_checkpointing() or the WF_CKPT_INTERVAL /
+        # WF_CKPT_DIR env knobs; restore_from enables it implicitly
+        self._coordinator = None
+        self._ckpt_enabled = False
+        self._ckpt_interval: Optional[float] = None
+        self._ckpt_dir: Optional[str] = None
+        self._ckpt_retain = 3
+        env_iv = os.environ.get("WF_CKPT_INTERVAL")
+        if env_iv:
+            try:
+                self.with_checkpointing(interval=float(env_iv))
+            except ValueError:
+                pass  # malformed knob must not take down the graph
+        if os.environ.get("WF_CKPT_DIR"):
+            self._ckpt_dir = os.environ["WF_CKPT_DIR"]
+
+    # ------------------------------------------------------------------
+    # checkpointing configuration
+    # ------------------------------------------------------------------
+    def with_checkpointing(self, interval: Optional[float] = None,
+                           store_dir: Optional[str] = None,
+                           retain: int = 3) -> "PipeGraph":
+        """Enable aligned-barrier checkpointing (windflow_tpu.checkpoint).
+
+        ``interval`` (seconds) drives periodic checkpoints; None disables
+        the timer — checkpoints then happen only on explicit triggers
+        (``SourceShipper.request_checkpoint()`` or
+        ``graph.trigger_checkpoint()``). ``store_dir`` is the on-disk
+        store root (default: ``WF_CKPT_DIR``, else
+        ``wf_checkpoints/<graph name>``); the last ``retain`` committed
+        checkpoints are kept. Env twins: ``WF_CKPT_INTERVAL`` /
+        ``WF_CKPT_DIR``."""
+        if self._started:
+            raise WindFlowError("with_checkpointing after start()")
+        self._ckpt_enabled = True
+        if interval is not None:
+            self._ckpt_interval = float(interval)
+        if store_dir is not None:
+            self._ckpt_dir = store_dir
+        self._ckpt_retain = retain
+        return self
+
+    def trigger_checkpoint(self) -> Optional[int]:
+        """Force a checkpoint epoch now (sources inject barriers at their
+        next tuple boundary). Returns the checkpoint id, or None when
+        checkpointing is not enabled/running."""
+        if self._coordinator is None:
+            return None
+        return self._coordinator.trigger(force=True)
+
+    def _ckpt_store_dir(self) -> str:
+        if self._ckpt_dir:
+            return self._ckpt_dir
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in self.name) or "pipegraph"
+        return os.path.join("wf_checkpoints", safe)
+
+    def _setup_checkpointing(self, restore_from: Optional[str]):
+        """Create store+coordinator (before _build so _make_workers can
+        wire them) and resolve the restore target. Returns
+        ``(ckpt_dir, manifest)`` or ``(None, None)``."""
+        from ..checkpoint import CheckpointCoordinator, CheckpointStore
+
+        resolved = None
+        if restore_from is not None:
+            resolved = CheckpointStore.resolve(restore_from)
+            if not self._ckpt_enabled:
+                # restoring implies checkpointing: keep writing new
+                # checkpoints into the same store unless told otherwise
+                self._ckpt_enabled = True
+                if self._ckpt_dir is None:
+                    self._ckpt_dir = os.path.dirname(resolved[1])
+        if not self._ckpt_enabled:
+            return None, None
+        store = CheckpointStore(self._ckpt_store_dir(),
+                                retain=self._ckpt_retain)
+        self._coordinator = CheckpointCoordinator(
+            store, self.name, interval_s=self._ckpt_interval)
+        if resolved is not None:
+            cid, ckpt_dir, manifest = resolved
+            # new epochs continue after the restored one; sources bind
+            # their injection cursor to this BEFORE any trigger fires
+            self._coordinator.requested_id = cid
+            self._coordinator.last_completed_id = cid
+            return ckpt_dir, manifest
+        return None, None
+
+    def _restore_replicas(self, ckpt_dir: str, manifest: Dict[str, Any]
+                          ) -> None:
+        """Push every blob's state into the matching rebuilt replica.
+        Topology mismatches fail loudly: silently dropping state would
+        trade a crash for wrong answers."""
+        states = self._coordinator.store.load_states(ckpt_dir, manifest)
+        by_name = {op.name: op for op in self._ops}
+        for (op_name, idx), state in states.items():
+            op = by_name.get(op_name)
+            if op is None:
+                raise WindFlowError(
+                    f"restore: checkpoint has state for operator "
+                    f"{op_name!r} which this graph does not contain")
+            if idx >= len(op.replicas):
+                raise WindFlowError(
+                    f"restore: operator {op_name!r} was checkpointed with "
+                    f"parallelism > {len(op.replicas)}; rescaling on "
+                    "restore is not supported yet")
+            replica = op.replicas[idx]
+            state = dict(state)
+            em_state = state.pop("__emitter__", None)
+            coll_state = state.pop("__collector__", None)
+            replica.restore_state(state)
+            if em_state is not None and replica.emitter is not None:
+                replica.emitter.restore_emitter_state(em_state)
+            coll = getattr(replica, "_collector", None)
+            if coll_state is not None and coll is not None:
+                coll.restore_state(coll_state)
 
     # ------------------------------------------------------------------
     def _register_op(self, op: BasicOperator) -> None:
@@ -297,15 +413,18 @@ class PipeGraph:
                 coll = self._make_collector(stage, i)
                 if coll is not None:
                     chain.append(coll)
+                    # restore path reaches the collector via its replica
+                    stage.first_op.replicas[i]._collector = coll
             chain.extend(op.replicas[i] for op in stage.ops)
-            w = Worker(f"{self.name}/{stage.describe()}[{i}]", chain, channel)
+            w = Worker(f"{self.name}/{stage.describe()}[{i}]", chain, channel,
+                       coordinator=self._coordinator)
             stage.workers.append(w)
             self._workers.append(w)
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
-    def start(self) -> None:
+    def start(self, restore_from: Optional[str] = None) -> None:
         if self._started:
             raise WindFlowError("PipeGraph already started")
         self._validate()
@@ -314,7 +433,16 @@ class PipeGraph:
             # inside a worker thread can deadlock the PJRT client handshake
             import jax
             jax.devices()
+        # checkpoint store/coordinator BEFORE _build: workers bind to the
+        # coordinator at construction, and sources anchor their barrier
+        # cursor to the restored epoch
+        ckpt_dir, manifest = self._setup_checkpointing(restore_from)
         self._build()
+        if ckpt_dir is not None:
+            self._restore_replicas(ckpt_dir, manifest)
+        if self._coordinator is not None:
+            self._coordinator.expected_acks = len(self._workers)
+            self._coordinator.start()
         self._started = True
         self._t0 = time.monotonic()
         if env_flag("WF_TRACING_ENABLED"):
@@ -335,6 +463,8 @@ class PipeGraph:
             w.join()
         self._ended = True
         self.elapsed_sec = time.monotonic() - self._t0
+        if self._coordinator is not None:
+            self._coordinator.stop()
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor.join(timeout=3)
@@ -344,9 +474,15 @@ class PipeGraph:
         if env_flag("WF_TRACING_ENABLED"):
             self.dump_stats(os.environ.get("WF_LOG_DIR", "log"))
 
-    def run(self) -> None:
-        """Blocking run (reference ``PipeGraph::run``, L610)."""
-        self.start()
+    def run(self, restore_from: Optional[str] = None) -> None:
+        """Blocking run (reference ``PipeGraph::run``, L610).
+
+        ``restore_from``: a checkpoint store root (resumes from the
+        latest committed checkpoint) or one checkpoint directory. The
+        topology must match the checkpointed one (same operator names
+        and parallelisms); replayable sources resume from their recorded
+        positions."""
+        self.start(restore_from)
         self.wait_end()
 
     def _validate(self) -> None:
@@ -382,7 +518,7 @@ class PipeGraph:
                 "parallelism": op.parallelism,
                 "replicas": [r.stats.to_dict() for r in op.replicas],
             })
-        return {
+        st = {
             "PipeGraph_name": self.name,
             "Mode": self.execution_mode.name,
             "Time_policy": self.time_policy.name,
@@ -390,6 +526,9 @@ class PipeGraph:
             "Dropped_tuples": self.dropped.value,
             "Operators": ops,
         }
+        if self._coordinator is not None:
+            st["Checkpoints"] = self._coordinator.stats()
+        return st
 
     def dump_stats(self, log_dir: str = "log") -> str:
         """JSON stats + the dataflow diagram. The reference renders a PDF

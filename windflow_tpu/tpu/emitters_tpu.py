@@ -916,8 +916,20 @@ class TPUSplittingEmitter(BasicEmitter, _D2HPipeline):
         for e in self.inner:
             e.send_eos_all()
 
+    def send_barrier_all(self, barrier) -> None:
+        self._drain()
+        for e in self.inner:
+            e.send_barrier_all(barrier)
+
     def eos_ports(self):
         return [p for e in self.inner for p in e.eos_ports()]
+
+    def emitter_state(self) -> dict:
+        return {"inner": [e.emitter_state() for e in self.inner]}
+
+    def restore_emitter_state(self, state: dict) -> None:
+        for e, st in zip(self.inner, state.get("inner", [])):
+            e.restore_emitter_state(st)
 
 
 class TPUColumnarExitEmitter(BasicEmitter, _D2HPipeline):
@@ -1014,5 +1026,15 @@ class TPUExitEmitter(BasicEmitter, _D2HPipeline):
         self._drain()
         self.inner.send_eos_all()
 
+    def send_barrier_all(self, barrier) -> None:
+        self._drain()
+        self.inner.send_barrier_all(barrier)
+
     def eos_ports(self):
         return self.inner.eos_ports()
+
+    def emitter_state(self) -> dict:
+        return self.inner.emitter_state()
+
+    def restore_emitter_state(self, state: dict) -> None:
+        self.inner.restore_emitter_state(state)

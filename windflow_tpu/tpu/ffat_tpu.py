@@ -1170,3 +1170,76 @@ class FfatTPUReplica(TPUReplicaBase):
 
     def flush_on_termination(self) -> None:
         self._fire_dataless(None, partial=True)
+
+    # ------------------------------------------------------------------
+    # checkpointing (windflow_tpu.checkpoint): the replica's whole
+    # processing state is the key map, the per-slot host bookkeeping
+    # arrays, and the device forest — one device_get per tree field
+    # (array-shaped state keeps the snapshot a transfer, not a
+    # serializer). Device-side caches (ktable, zero-fire constants) and
+    # compiled programs rebuild lazily after restore.
+    def snapshot_state(self) -> dict:
+        import jax
+
+        st = super().snapshot_state()  # drains the dispatch queue
+        st["ffat"] = {
+            "slot_of_key": dict(self.slot_of_key),
+            "out_keys_by_slot": list(self._out_keys_by_slot),
+            "K_cap": self.K_cap, "F": self.F,
+            "next_fire": self.next_fire.copy(),
+            "fired": self.fired.copy(),
+            "max_leaf": self.max_leaf.copy(),
+            "count": self.count.copy(),
+            "keys_np": self._keys_np.copy(),
+            "keys_all_int": self._keys_all_int,
+            "key_dtype": self._key_dtype,
+            "saw_new_key": self._saw_new_key,
+            "leaf_frontier": self._leaf_frontier,
+            "fire_ewma": self._fire_ewma,
+            "rebuild_dirty": self._rebuild_dirty,
+            "ignored": self.ignored,
+            "trees": (None if self.trees is None
+                      else jax.device_get(self.trees)),
+            "tvalid": (None if self.tvalid is None
+                       else np.asarray(jax.device_get(self.tvalid))),
+        }
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        d = state.get("ffat")
+        if d is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        # capacity/ring geometry first: the arrays below are shaped by it
+        self.K_cap = d["K_cap"]
+        self.F = d["F"]
+        self._check_index_plane()
+        self.slot_of_key.clear()  # shared alias with the KeySlotMap
+        self.slot_of_key.update(d["slot_of_key"])
+        self._keymap._lut = None
+        self._out_keys_by_slot = list(d["out_keys_by_slot"])
+        self.next_fire = d["next_fire"].copy()
+        self.fired = d["fired"].copy()
+        self.max_leaf = d["max_leaf"].copy()
+        self.count = d["count"].copy()
+        self._keys_np = d["keys_np"].copy()
+        self._keys_all_int = d["keys_all_int"]
+        self._key_dtype = d["key_dtype"]
+        self._saw_new_key = d["saw_new_key"]
+        self._leaf_frontier = d["leaf_frontier"]
+        self._fire_ewma = d["fire_ewma"]
+        self._rebuild_dirty = d["rebuild_dirty"]
+        self.ignored = d["ignored"]
+        self.trees = (None if d["trees"] is None else
+                      jax.tree_util.tree_map(jnp.asarray, d["trees"]))
+        self.tvalid = (None if d["tvalid"] is None
+                       else jnp.asarray(d["tvalid"]))
+        # device-side caches are stale for the restored geometry
+        self._ktable_dev = None
+        self._ktable_kd = None
+        self._ktable_dirty = True
+        self._zero_fire_cache = {}
+        self._seg_dummy = None

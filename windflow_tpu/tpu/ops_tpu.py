@@ -145,6 +145,13 @@ class TPUReplicaBase(BasicReplica):
             self.dispatch.drain(forced=True)
         super().terminate()
 
+    def snapshot_state(self) -> dict:
+        # the checkpointing worker drains the dispatch queue before
+        # snapshotting, but device state must never be captured with
+        # commits in flight (donation reassigns it) — drain defensively
+        self.dispatch.drain(forced=True)
+        return super().snapshot_state()
+
     def _emit_batch(self, batch: BatchTPU) -> None:
         self.stats.device_batches_out += 1
         self.emitter.emit_device_batch(batch)
@@ -480,6 +487,31 @@ class _KeyedStateScan:
         return cached_compile(self._cache, self._cache_lock, (M, KB),
                               lambda: self._make(M, KB))
 
+    # -- checkpointing -----------------------------------------------------
+    # The whole scan state is (key -> slot dict, capacity, one device
+    # pytree): device_get it to host numpy for the blob (DrJAX-style —
+    # array state makes snapshots a transfer, not a serializer) and
+    # device_put it back on restore. The KeySlotMap LUT refills lazily
+    # from the restored dict, and compiled programs re-trace on demand.
+    def snapshot_state(self) -> dict:
+        import jax
+        return {"slot_of_key": dict(self.slot_of_key),
+                "table_capacity": self.table_capacity,
+                "table": (None if self.table is None
+                          else jax.device_get(self.table))}
+
+    def restore_state(self, state: dict) -> None:
+        import jax
+
+        self.slot_of_key.clear()  # shared alias with the KeySlotMap
+        self.slot_of_key.update(state.get("slot_of_key", {}))
+        self._keymap._lut = None
+        self.table_capacity = state.get("table_capacity",
+                                        self.table_capacity)
+        table = state.get("table")
+        self.table = (None if table is None
+                      else jax.tree_util.tree_map(jax.device_put, table))
+
 
 class StatefulMapTPUReplica(TPUReplicaBase):
     """Per-key device state via the grid scan (see _KeyedStateScan)."""
@@ -505,6 +537,16 @@ class StatefulMapTPUReplica(TPUReplicaBase):
 
         return commit
 
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["scan"] = self.engine.snapshot_state()
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        if "scan" in state:
+            self.engine.restore_state(state["scan"])
+
 
 class StatefulFilterTPUReplica(TPUReplicaBase):
     """Keyed-state predicate + compaction in one program (the reference's
@@ -529,6 +571,16 @@ class StatefulFilterTPUReplica(TPUReplicaBase):
             self.emit_compacted(batch, out, order, count)
 
         return commit
+
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["scan"] = self.engine.snapshot_state()
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        if "scan" in state:
+            self.engine.restore_state(state["scan"])
 
 
 # ---------------------------------------------------------------------------
