@@ -623,16 +623,17 @@ def _sync(sink: "_CountingEmitter") -> None:
 
 
 def _run_op_config(make_op, n_keys: int, n_batches: int,
-                   repeats: int = 1):
+                   repeats: int = 1, batch_size: int = 0):
     """Generic device-op throughput: pre-staged keyed batches -> op.
     Best contiguous chunk of ``repeats`` (same protocol as _run_config)."""
+    B = batch_size or BATCH
     op = make_op()
     op.build_replicas()
     rep = op.replicas[0]
     sink = _CountingEmitter()
     rep.emitter = sink
     bs = _stage_batches(n_keys, repeats * n_batches + WARMUP, 1,
-                        with_ts=False)
+                        with_ts=False, batch_size=B)
     for b in bs[:WARMUP]:
         rep.handle_msg(0, b)
     rep.dispatch.drain()
@@ -645,7 +646,7 @@ def _run_op_config(make_op, n_keys: int, n_batches: int,
             rep.handle_msg(0, b)
         rep.dispatch.drain()  # deferred commits must emit to count
         _sync(sink)
-        best = max(best, n_batches * BATCH / (time.perf_counter() - t0))
+        best = max(best, n_batches * B / (time.perf_counter() - t0))
     return best
 
 
@@ -930,8 +931,31 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
                                          "value": a["value"] + b["value"]},
                            key_extractor="key", name="bench_kred"), 256, 12,
         repeats=REPEATS)
+
+    def _fused_chain_op():
+        # 3-op device chain (map∘filter∘map) as ONE fused replica — one
+        # XLA program + one dispatch commit per batch (tpu/fused_ops.py);
+        # measured at 16k batches, the host-bound regime fusion targets
+        from windflow_tpu.tpu.fused_ops import FusedTPUReplica
+        from windflow_tpu.tpu.ops_tpu import Filter_TPU
+
+        class _FusedChain:
+            def build_replicas(self):
+                ops = [Map_TPU(lambda f: {**f, "value": f["value"] * 3
+                                          + f["key"]}, name="bench_fm1"),
+                       Filter_TPU(lambda f: (f["value"] % 2) == 0,
+                                  name="bench_ff1"),
+                       Map_TPU(lambda f: {**f, "value": f["value"] + 1},
+                               name="bench_fm2")]
+                self.replicas = [FusedTPUReplica(ops, 0)]
+
+        return _FusedChain()
+
+    fused_tps = _run_op_config(_fused_chain_op, 64, 12, repeats=REPEATS,
+                               batch_size=16384)
     _log(f"stateful map {smap_tps:,.0f} t/s, "
-         f"keyed reduce {kred_tps:,.0f} t/s")
+         f"keyed reduce {kred_tps:,.0f} t/s, "
+         f"fused 3-op chain {fused_tps:,.0f} t/s (16k)")
 
     metric = "ffat_sliding_window_tuples_per_sec_per_chip"
     if fallback or platform == "cpu":
@@ -958,6 +982,7 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
         "hc_sparse_wm_tuples_per_sec": round(sw_st["mean"], 1),
         "stateful_map_tuples_per_sec": round(smap_tps, 1),
         "keyed_reduce_tuples_per_sec": round(kred_tps, 1),
+        "fused_chain_tuples_per_sec": round(fused_tps, 1),
     }
     if os.environ.get("WF_BENCH_CONTENDED") == "1":
         # measured while another relay client (watcher probe/session or

@@ -470,6 +470,114 @@ def bench_checkpoint() -> None:
                           "align_stall_usec_total": round(stall, 1)}))
 
 
+def bench_fusion() -> None:
+    """--fusion: device-chain fusion (tpu/fused_ops.py) on a 3-op
+    Map -> Filter -> Map device chain, fused (one ``FusedTPUReplica``,
+    one XLA program + one dispatch commit per batch) vs unfused (the
+    ``WF_TPU_FUSION=0`` wiring: three standalone replicas, three
+    programs, a mid-chain compaction readback). Reports tuples/s for
+    both legs, programs-per-batch, and the fused leg's host-prep /
+    device-commit split. The unfused leg is driven on one thread without
+    channel hops, so the measured win UNDERSTATES the graph-level win
+    (fusion also removes two channel hops and two worker threads)."""
+    import jax
+
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.fused_ops import FusedTPUReplica
+    from windflow_tpu.tpu.ops_tpu import (Filter_TPU, FilterTPUReplica,
+                                          Map_TPU, MapTPUReplica)
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    B, NB, WARMUP = 16384, 24, 4
+    schema = TupleSchema({"key": np.int32, "value": np.int32})
+    rng = np.random.default_rng(0)
+    batches = []
+    for i in range(NB + WARMUP):
+        cols = {"key": jax.device_put(
+                    rng.integers(0, 64, B).astype(np.int32)),
+                "value": jax.device_put(
+                    rng.integers(0, 1000, B).astype(np.int32))}
+        batches.append(BatchTPU(cols, np.arange(B, dtype=np.int64), B,
+                                schema))
+
+    class _Sink:
+        def __init__(self):
+            self.tuples = 0
+
+        def emit_device_batch(self, b):
+            self.tuples += b.size
+
+        def set_stats(self, s):
+            pass
+
+    class _Feed:
+        """Inline edge: what the unfused worker chain does per hop."""
+
+        def __init__(self, nxt):
+            self.nxt = nxt
+
+        def emit_device_batch(self, b):
+            self.nxt.handle_msg(0, b)
+
+        def set_stats(self, s):
+            pass
+
+    def mk_ops():
+        return (Map_TPU(lambda f: {**f, "value": f["value"] * 3 + f["key"]},
+                        name="m1"),
+                Filter_TPU(lambda f: (f["value"] % 2) == 0, name="f1"),
+                Map_TPU(lambda f: {**f, "value": f["value"] + 1},
+                        name="m2"))
+
+    def drive(chain, sink):
+        for bt in batches[:WARMUP]:
+            chain[0].handle_msg(0, bt)
+        for r in chain:
+            r.dispatch.drain()
+        progs0 = sum(r.stats.device_programs_run for r in chain)
+        n0 = sink.tuples
+        t0 = time.perf_counter()
+        for bt in batches[WARMUP:]:
+            chain[0].handle_msg(0, bt)
+        for r in chain:
+            r.dispatch.drain()
+        wall = time.perf_counter() - t0
+        progs = sum(r.stats.device_programs_run for r in chain) - progs0
+        return NB * B / wall, progs / NB, sink.tuples - n0
+
+    m1, f1, m2 = mk_ops()
+    r1, r2, r3 = (MapTPUReplica(m1, 0), FilterTPUReplica(f1, 0),
+                  MapTPUReplica(m2, 0))
+    sink_u = _Sink()
+    r1.set_emitter(_Feed(r2))
+    r2.set_emitter(_Feed(r3))
+    r3.set_emitter(sink_u)
+    tps_u, ppb_u, n_u = drive([r1, r2, r3], sink_u)
+
+    fm1, ff1, fm2 = mk_ops()
+    fr = FusedTPUReplica([fm1, ff1, fm2], 0)
+    sink_f = _Sink()
+    fr.set_emitter(sink_f)
+    st = fr.stats
+    prep0, commit0 = (st.dispatch_host_prep_total_us,
+                      st.dispatch_commit_total_us)
+    tps_f, ppb_f, n_f = drive([fr], sink_f)
+    assert n_f == n_u, (n_f, n_u)  # same delivered tuple count
+
+    report("fusion_fused_tuples_per_sec", tps_f)
+    report("fusion_unfused_tuples_per_sec", tps_u)
+    print(json.dumps({"bench": "fusion_programs_per_batch",
+                      "fused": round(ppb_f, 3),
+                      "unfused": round(ppb_u, 3)}))
+    print(json.dumps({"bench": "fusion_fused_vs_unfused",
+                      "value": round(tps_f / tps_u, 3) if tps_u else 0.0,
+                      "unit": "speedup"}))
+    report("fusion_fused_host_prep_us_per_batch",
+           (st.dispatch_host_prep_total_us - prep0) / NB, "usec")
+    report("fusion_fused_device_commit_us_per_batch",
+           (st.dispatch_commit_total_us - commit0) / NB, "usec")
+
+
 def bench_cpu_plane() -> None:
     """Per-tuple Python plane: 3-op chain end-to-end (the CPU plane is
     functor-bound by design; the device plane is the throughput story)."""
@@ -505,12 +613,16 @@ def main() -> None:
     if "--checkpoint" in sys.argv[1:]:
         bench_checkpoint()
         return
+    if "--fusion" in sys.argv[1:]:
+        bench_fusion()
+        return
     bench_staging()
     bench_reshard()
     bench_channels()
     bench_exit_decode()
     bench_exit_pipeline()
     bench_dispatch()
+    bench_fusion()
     bench_cpu_plane()
     bench_latency()
     bench_checkpoint()

@@ -502,3 +502,116 @@ def test_dbhandle_export_atomic_ignores_torn_tmp(tmp_path):
     assert conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0] == 1
     conn.close()
     db.close()
+
+
+# ---------------------------------------------------------------------------
+# fused device chains (tpu/fused_ops.py): kill-and-restore + positional
+# per-sub-op state + loud failure on differently-fused topologies
+# ---------------------------------------------------------------------------
+def _fused_chain_graph(store, src, results, tmp):
+    """Stateful map ∘ filter ∘ map fused into ONE device replica: the
+    chain snapshot must hold one positional entry per sub-op."""
+    import numpy as np
+
+    from windflow_tpu.tpu.builders_tpu import (Filter_TPU_Builder,
+                                               Map_TPU_Builder)
+
+    g = PipeGraph("ck_fused", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    smap = (Map_TPU_Builder(
+        lambda row, state: ({"k": row["k"], "v": row["v"] + state["acc"]},
+                            {"acc": state["acc"] + row["v"]}))
+        .with_key_by("k").with_state({"acc": np.int64(0)})
+        .with_name("smap").build())
+    flt = (Filter_TPU_Builder(lambda f: f["v"] % 3 != 0)
+           .with_name("fodd").build())
+    mtail = (Map_TPU_Builder(lambda f: {**f, "v": f["v"] * 2})
+             .with_name("mtail").build())
+
+    def sink(t):
+        # running per-key prefix sums are strictly increasing, so the
+        # per-key max is idempotent under at-least-once replay
+        if t is not None:
+            k, v = int(t["k"]), int(t["v"])
+            results[k] = max(v, results.get(k, -1))
+
+    g.add_source(Source_Builder(src).with_name("src")
+                 .with_output_batch_size(64).build()) \
+        .add(smap).chain(flt).chain(mtail) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g
+
+
+def test_recovery_fused_device_chain(tmp_path, monkeypatch):
+    monkeypatch.setenv("WF_TPU_FUSION", "1")
+    golden = {}
+    _fused_chain_graph(str(tmp_path / "gold_store"), ReplaySource(2000),
+                       golden, str(tmp_path / "gold")).run()
+    store = str(tmp_path / "store")
+    crash_res = {}
+    g = _fused_chain_graph(store, ReplaySource(2000, ckpt_at=600,
+                                               crash_at=1200),
+                           crash_res, str(tmp_path / "crash"))
+    # the chain really fused (otherwise this test proves nothing)
+    assert any(s.is_fused_tpu for s in g._stages)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    assert g._coordinator.completed == 1
+
+    # the committed blob holds the fused signature + one POSITIONAL
+    # entry per sub-op (index 0 = the stateful map's grid table)
+    cid, ckpt_dir, manifest = CheckpointStore.resolve(store)
+    states = CheckpointStore(store).load_states(ckpt_dir, manifest)
+    fused_blobs = {k: v for k, v in states.items() if k[0] == "smap"}
+    assert fused_blobs, "fused chain blob must be keyed by the head op"
+    for state in fused_blobs.values():
+        assert state["__fused__"] == ["smap", "fodd", "mtail"]
+        subs = state["fused_sub_states"]
+        assert len(subs) == 3
+        assert subs[0] is not None and subs[0]["table"] is not None
+        assert subs[1] is None and subs[2] is None  # stateless sub-ops
+
+    restore_res = {}
+    g2 = _fused_chain_graph(store, ReplaySource(2000), restore_res,
+                            str(tmp_path / "crash"))
+    g2.run(restore_from=store)
+    merged = {k: max(crash_res.get(k, -1), restore_res.get(k, -1))
+              for k in set(crash_res) | set(restore_res)}
+    assert merged == golden
+    assert len(golden) > 0
+
+
+def test_restore_into_differently_fused_topology_fails(tmp_path,
+                                                       monkeypatch):
+    """A checkpoint taken from a FUSED chain must refuse to restore into
+    an unfused build of the same pipeline (and vice versa) instead of
+    silently dropping the per-sub-op state."""
+    from windflow_tpu import WindFlowError
+
+    monkeypatch.setenv("WF_TPU_FUSION", "1")
+    store = str(tmp_path / "store")
+    g = _fused_chain_graph(store, ReplaySource(800, ckpt_at=300), {},
+                           str(tmp_path / "run1"))
+    g.run()
+    assert g._coordinator.completed == 1
+
+    # fused checkpoint -> unfused topology: loud failure
+    monkeypatch.setenv("WF_TPU_FUSION", "0")
+    g_unfused = _fused_chain_graph(str(tmp_path / "store2"),
+                                   ReplaySource(800), {},
+                                   str(tmp_path / "run2"))
+    assert not any(s.is_fused_tpu for s in g_unfused._stages)
+    with pytest.raises(WindFlowError, match="fused"):
+        g_unfused.run(restore_from=store)
+
+    # unfused checkpoint -> fused topology: loud failure too
+    store3 = str(tmp_path / "store3")
+    g3 = _fused_chain_graph(store3, ReplaySource(800, ckpt_at=300), {},
+                            str(tmp_path / "run3"))
+    g3.run()
+    assert g3._coordinator.completed == 1
+    monkeypatch.setenv("WF_TPU_FUSION", "1")
+    g4 = _fused_chain_graph(str(tmp_path / "store4"), ReplaySource(800),
+                            {}, str(tmp_path / "run4"))
+    with pytest.raises(WindFlowError, match="fused"):
+        g4.run(restore_from=store3)

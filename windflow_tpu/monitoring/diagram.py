@@ -105,9 +105,15 @@ def stages_to_svg(stages: List, title: str = "") -> str:
         par = "|".join(str(o.parallelism) for o in s.ops)
         is_dev = any(getattr(o, "is_tpu", False) for o in s.ops)
         fill = "#e8f0fe" if is_dev else "#f5f5f5"
+        refused = getattr(s, "chain_refused", None)
+        # chain() fallback diagnostics as a hover tooltip (the dot output
+        # carries the same reason as a label line)
+        tooltip = ("<title>" + html.escape(f"unchained: {refused}",
+                                           quote=False) + "</title>"
+                   if refused else "")
         out.append(
             f'<rect x="{x}" y="{y}" width="{_BOX_W}" height="{_BOX_H}" '
-            f'rx="7" fill="{fill}" stroke="#888"/>')
+            f'rx="7" fill="{fill}" stroke="#888">{tooltip}</rect>')
         out.append(f'<text x="{x + _BOX_W / 2}" y="{y + 19}" '
                    f'text-anchor="middle">{label}</text>')
         out.append(f'<text x="{x + _BOX_W / 2}" y="{y + 36}" '

@@ -50,6 +50,10 @@ class StatsRecord:
         "hist_service", "hist_prep", "hist_commit", "hist_e2e",
         # queue / backpressure plane
         "input_channel", "pipe_depth_max", "worker_idle_ticks",
+        # device-chain fusion (tpu/fused_ops.py): number of sub-operators
+        # fused into this replica's single per-batch program (0 = not a
+        # fused replica)
+        "fused_ops",
     )
 
     def __init__(self, op_name: str = "", replica_idx: int = 0,
@@ -116,6 +120,7 @@ class StatsRecord:
         self.input_channel = None  # wired by PipeGraph._make_workers
         self.pipe_depth_max = 0  # emitter-side FIFO high-water mark
         self.worker_idle_ticks = 0
+        self.fused_ops = 0  # sub-ops fused into this replica's program
 
     # -- service-time recording (wf/basic_operator.hpp:134-158) -------------
     def start_svc(self) -> None:
@@ -209,6 +214,7 @@ class StatsRecord:
             "Device_bytes_H2D": self.device_bytes_h2d,
             "Device_bytes_D2H": self.device_bytes_d2h,
             "Device_programs_run": self.device_programs_run,
+            "Fused_ops": self.fused_ops,
             "Staging_pool_hits": self.staging_pool_hits,
             "Staging_pool_misses": self.staging_pool_misses,
             "Dispatch_host_prep_usec": round(self.dispatch_host_prep_us, 3),

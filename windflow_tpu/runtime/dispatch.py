@@ -92,10 +92,14 @@ class DeviceDispatchQueue:
             self._run(commit)
             return
         self._q.append(commit)
-        while len(self._q) > self.depth:
-            self._run(self._q.popleft())
+        # record the PEAK occupancy (post-append, pre-pop): a pipeline
+        # running steady-state at full depth overflows on every submit,
+        # and recording only the post-pop length would under-report
+        # Dispatch_queue_depth_max as never-saturated
         if self.stats is not None:
             self.stats.note_dispatch_depth(len(self._q))
+        while len(self._q) > self.depth:
+            self._run(self._q.popleft())
 
     def drain(self, forced: bool = False) -> None:
         """Commit everything in flight. ``forced=True`` marks an

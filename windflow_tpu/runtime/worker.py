@@ -52,9 +52,15 @@ class Worker(threading.Thread):
         self._eos_seen = 0
         self._has_coll = hasattr(chain[0], "on_channel_eos")
         # replicas = chain nodes that carry operator state (the collector,
-        # when present, is snapshotted alongside the first replica)
-        self._replicas = [n for n in chain if hasattr(n, "snapshot_state")
-                          and hasattr(n, "op")]
+        # when present, is snapshotted alongside the first replica).
+        # Deduped by identity: every sub-op of a fused device stage
+        # aliases ONE FusedTPUReplica, which must drain/snapshot/
+        # terminate exactly once
+        self._replicas = []
+        for n in chain:
+            if hasattr(n, "snapshot_state") and hasattr(n, "op") \
+                    and not any(n is r for r in self._replicas):
+                self._replicas.append(n)
         self._aligner: Optional[BarrierAligner] = None
         if coordinator is not None and channel is None and chain:
             # source chain: the source replica injects barriers at tuple

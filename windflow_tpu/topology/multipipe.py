@@ -95,17 +95,35 @@ class MultiPipe:
         return self
 
     def chain(self, op: BasicOperator) -> "MultiPipe":
-        """Fuse into the tail stage's thread when legal, else fall back to
-        ``add`` (reference behavior, ``wf/multipipe.hpp:1050-1100``)."""
+        """Fuse into the tail stage's thread (or, for consecutive device
+        operators, its XLA program — ``topology/stage.py`` fusion rules)
+        when legal, else fall back to ``add`` (reference behavior,
+        ``wf/multipipe.hpp:1050-1100``). A refused chain records WHY on
+        the fallback stage (``Stage.chain_refused``), surfaced by
+        ``describe(diagnostics=True)`` and the dataflow diagram —
+        silently degrading to a shuffle stage cost a PERF.md round to
+        diagnose once."""
         self._check_open("chain")
         tails = self._tails
-        if len(tails) == 1 and not self.was_merged and tails[0].can_chain(op):
-            self._claim(op)
-            tails[0].chain(op)
-            if op.op_type == OpType.SINK:
-                self.has_sink = True
-            return self
-        return self.add(op)
+        if len(tails) == 1 and not self.was_merged:
+            reason = tails[0].chain_refusal(op)
+            if reason is None:
+                self._claim(op)
+                tails[0].chain(op)
+                if op.op_type == OpType.SINK:
+                    self.has_sink = True
+                return self
+        elif self.was_merged:
+            reason = "chain after a merge needs a shuffle stage"
+        elif not tails:
+            reason = "first operator of a split branch starts its own stage"
+        else:
+            reason = "multiple open tails need a merging stage"
+        self.add(op)
+        for group in self.tail_groups:
+            for stage in group:
+                stage.chain_refused = reason
+        return self
 
     def add_sink(self, op: BasicOperator) -> "MultiPipe":
         if op.op_type != OpType.SINK:

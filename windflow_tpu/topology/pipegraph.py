@@ -151,12 +151,29 @@ class PipeGraph:
                 raise WindFlowError(
                     f"restore: checkpoint has state for operator "
                     f"{op_name!r} which this graph does not contain")
+            if getattr(op, "_fused_hidden", False):
+                raise WindFlowError(
+                    f"restore: checkpoint holds standalone state for "
+                    f"{op_name!r}, but this graph fuses it into the "
+                    "device chain "
+                    f"{op.replicas[0].fused_name!r} — the checkpointed "
+                    "topology was fused differently (match WF_TPU_FUSION "
+                    "/ the chain() calls of the original graph)")
             if idx >= len(op.replicas):
                 raise WindFlowError(
                     f"restore: operator {op_name!r} was checkpointed with "
                     f"parallelism > {len(op.replicas)}; rescaling on "
                     "restore is not supported yet")
             replica = op.replicas[idx]
+            if state.get("__fused__") is not None \
+                    and getattr(replica, "fused_signature", None) is None:
+                raise WindFlowError(
+                    f"restore: checkpoint blob for {op_name!r} holds a "
+                    f"fused device chain {'∘'.join(state['__fused__'])!r}, "
+                    "but this graph runs the operator standalone — the "
+                    "checkpointed topology was fused differently (match "
+                    "WF_TPU_FUSION / the chain() calls of the original "
+                    "graph)")
             state = dict(state)
             em_state = state.pop("__emitter__", None)
             coll_state = state.pop("__collector__", None)
@@ -194,7 +211,22 @@ class PipeGraph:
         for s in self._stages:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)
-                op.build_replicas()
+            if s.is_fused_tpu:
+                # chained device stage: ONE fused replica per slot runs
+                # the whole chain as a single XLA program (fused_ops.py).
+                # Every sub-op aliases the fused replica list so edge
+                # wiring (first_op/last_op.replicas) stays uniform.
+                from ..tpu.fused_ops import FusedTPUReplica
+                fused = [FusedTPUReplica(s.ops, i)
+                         for i in range(s.parallelism)]
+                label = s.describe()
+                for op in s.ops:
+                    op.replicas = fused
+                    op._fused_hidden = op is not s.first_op
+                s.first_op._fused_stage_label = label
+            else:
+                for op in s.ops:
+                    op.build_replicas()
         # channels (one per consumer replica); the native C++ ring stays
         # OPT-IN (WF_NATIVE_CHANNELS=1): measured 2026-07-29, the Python
         # deque+Condition channel moves ~1.0M msg/s vs ~0.3M for the
@@ -209,8 +241,12 @@ class PipeGraph:
             if not s.is_source:
                 s.channels = [channel_cls(self.channel_capacity)
                               for _ in range(s.parallelism)]
-        # intra-stage chain wiring (fused InlinePort edges)
+        # intra-stage chain wiring (fused InlinePort edges); fused device
+        # stages have no intra-stage edges at all — the chain is one
+        # program inside one replica
         for s in self._stages:
+            if s.is_fused_tpu:
+                continue
             for a, b in zip(s.ops[:-1], s.ops[1:]):
                 for i in range(s.parallelism):
                     em = ForwardEmitter(1, 0, self.execution_mode)
@@ -415,7 +451,12 @@ class PipeGraph:
                     chain.append(coll)
                     # restore path reaches the collector via its replica
                     stage.first_op.replicas[i]._collector = coll
-            chain.extend(op.replicas[i] for op in stage.ops)
+            if stage.is_fused_tpu:
+                # every sub-op aliases the same fused replica: the worker
+                # chain holds it once
+                chain.append(stage.first_op.replicas[i])
+            else:
+                chain.extend(op.replicas[i] for op in stage.ops)
             w = Worker(f"{self.name}/{stage.describe()}[{i}]", chain, channel,
                        coordinator=self._coordinator)
             stage.workers.append(w)
@@ -512,9 +553,13 @@ class PipeGraph:
     def get_stats(self) -> Dict[str, Any]:
         ops = []
         for op in self._ops:
+            if getattr(op, "_fused_hidden", False):
+                continue  # reported once under the fused stage's name
+            fused_label = getattr(op, "_fused_stage_label", None)
             ops.append({
-                "name": op.name,
-                "kind": type(op).__name__,
+                "name": fused_label or op.name,
+                "kind": ("Fused_TPU_Chain" if fused_label
+                         else type(op).__name__),
                 "parallelism": op.parallelism,
                 "replicas": [r.stats.to_dict() for r in op.replicas],
             })
@@ -571,7 +616,13 @@ class PipeGraph:
         for s in self._stages:
             label = s.describe().replace('"', "'")
             par = "|".join(str(o.parallelism) for o in s.ops)
-            lines.append(f'  s{s.id} [label="{label}\\n({par})"];')
+            extra = ""
+            if s.chain_refused:
+                # chain() fallback diagnostics: why this stage did not
+                # fuse into its predecessor
+                reason = s.chain_refused.replace('"', "'")
+                extra = f"\\n[unchained: {reason}]"
+            lines.append(f'  s{s.id} [label="{label}\\n({par}){extra}"];')
         for s in self._stages:
             for e in s.upstreams:
                 style = ""

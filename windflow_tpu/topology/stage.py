@@ -17,10 +17,33 @@ plain DAG of stages; the *semantics* preserved from the reference are:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
 from ..operators.base import BasicOperator
+
+
+def tpu_fusion_enabled() -> bool:
+    """Device-chain fusion opt-out (``WF_TPU_FUSION=0`` falls back to
+    today's per-stage wiring: one thread + one XLA program per device
+    operator). Default on; read at chain() time so tests can A/B."""
+    return os.environ.get("WF_TPU_FUSION", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def _keys_compatible(a: BasicOperator, b: BasicOperator) -> bool:
+    """Two keyed device ops partition identically: same key field(s), or
+    the very same extractor callable. Under equal parallelism the KEYBY
+    re-shard between them is then the identity (same hash, same
+    destination), so fusing drops the shuffle without changing which
+    replica owns a key."""
+    if a.key_field is not None or b.key_field is not None:
+        return a.key_field == b.key_field
+    if getattr(a, "key_fields", None) or getattr(b, "key_fields", None):
+        return getattr(a, "key_fields", None) == getattr(b, "key_fields",
+                                                         None)
+    return a.key_extractor is b.key_extractor
 
 
 class UpstreamEdge:
@@ -45,6 +68,9 @@ class Stage:
         self.split_logic: Optional[Callable] = None
         self.split_branches: List[Optional["Stage"]] = []
         self.split_tpu = False  # split after a device-batch operator
+        # chain() fallback diagnostics: why this stage could not fuse
+        # into its predecessor (None = it was never a chain candidate)
+        self.chain_refused: Optional[str] = None
         # runtime artifacts (filled at build time)
         self.channels: List[Any] = []  # one Channel per replica
         self.workers: List[Any] = []
@@ -75,20 +101,85 @@ class Stage:
     def is_split(self) -> bool:
         return self.split_logic is not None
 
+    @property
+    def is_fused_tpu(self) -> bool:
+        """A chained stage whose operators are all device ops runs as ONE
+        fused replica per slot (``tpu/fused_ops.py``) instead of a thread
+        chain of inline-wired replicas."""
+        return len(self.ops) > 1 and all(
+            getattr(o, "is_tpu", False) for o in self.ops)
+
     def can_chain(self, op: BasicOperator) -> bool:
-        """Reference chaining rule: FORWARD input, same parallelism, and the
-        new operator must be chain-compatible (``wf/multipipe.hpp:537-590``,
-        Reduce/windows excluded at 1058-1060)."""
-        return (op.is_chainable
-                and op.input_routing in (RoutingMode.FORWARD,)
-                and op.parallelism == self.parallelism
-                and not self.is_split
-                and not self.is_sink
-                and self.last_op.op_type not in (OpType.WIN, OpType.JOIN,
-                                                 OpType.WIN_TPU, OpType.TPU))
+        return self.chain_refusal(op) is None
+
+    def chain_refusal(self, op: BasicOperator) -> Optional[str]:
+        """Why ``op`` cannot join this stage's thread/program — None when
+        chaining is legal. CPU chaining follows the reference rule
+        (FORWARD input, same parallelism, chain-compatible kind,
+        ``wf/multipipe.hpp:537-590``, Reduce/windows excluded at
+        1058-1060); device chaining follows the fusion legality rules
+        (``_tpu_fusion_refusal``). The reason string is recorded on the
+        fallback stage and surfaced by ``describe()`` / the diagram."""
+        if self.is_split:
+            return "tail stage was split"
+        if self.is_sink:
+            return "tail stage already ends in a sink"
+        if op.parallelism != self.parallelism:
+            return (f"parallelism mismatch ({op.parallelism} vs "
+                    f"{self.parallelism})")
+        tail_tpu = getattr(self.last_op, "is_tpu", False)
+        cand_tpu = getattr(op, "is_tpu", False)
+        if tail_tpu or cand_tpu:
+            if not (tail_tpu and cand_tpu):
+                return "device and host operators never share a stage"
+            return self._tpu_fusion_refusal(op)
+        if self.last_op.op_type in (OpType.WIN, OpType.JOIN,
+                                    OpType.WIN_TPU):
+            return (f"{self.last_op.name} ({self.last_op.op_type.value}) "
+                    "terminates a chain")
+        if op.input_routing not in (RoutingMode.FORWARD,):
+            return (f"{op.input_routing.name} input routing needs its own "
+                    "shuffle stage")
+        if not op.is_chainable:
+            return f"{op.name} is not chain-compatible"
+        return None
+
+    def _tpu_fusion_refusal(self, op: BasicOperator) -> Optional[str]:
+        """Device-chain fusion legality: consecutive FORWARD (or
+        key-compatible KEYBY) same-parallelism device transforms fuse
+        into one XLA program; a global Reduce_TPU may terminate the
+        chain. Everything else keeps its own stage."""
+        if not tpu_fusion_enabled():
+            return "device-chain fusion disabled (WF_TPU_FUSION=0)"
+        if getattr(self.last_op, "fusion_role", None) == "terminator":
+            return (f"{self.last_op.name} (global Reduce_TPU) already "
+                    "terminates the fused chain")
+        if any(getattr(o, "fusion_role", None) is None for o in self.ops):
+            return (f"{self.first_op.name} has no composable device "
+                    "kernel (window/mesh operators own their stage)")
+        role = getattr(op, "fusion_role", None)
+        if role is None:
+            return (f"{op.name} has no composable device kernel "
+                    "(window/mesh/keyed-reduce operators own their stage)")
+        routing = op.input_routing
+        if routing is RoutingMode.KEYBY:
+            if self.first_op.input_routing is not RoutingMode.KEYBY:
+                return (f"{op.name} is keyed but the chain entry "
+                        f"({self.first_op.name}) is not — the KEYBY "
+                        "shuffle needs its own stage boundary")
+            if not _keys_compatible(self.first_op, op):
+                return (f"{op.name} keys differ from the chain entry's — "
+                        "fusing would skip a real re-shard")
+        elif routing is not RoutingMode.FORWARD:
+            return (f"{routing.name} input routing needs its own shuffle "
+                    "stage")
+        return None
 
     def chain(self, op: BasicOperator) -> None:
         self.ops.append(op)
 
-    def describe(self) -> str:
-        return "∘".join(o.name for o in self.ops)
+    def describe(self, diagnostics: bool = False) -> str:
+        label = "∘".join(o.name for o in self.ops)
+        if diagnostics and self.chain_refused:
+            label += f" [unchained: {self.chain_refused}]"
+        return label

@@ -1,0 +1,297 @@
+"""FusedTPUReplica: one XLA program per batch across a chained device
+stage.
+
+The reference fuses chain-compatible operators into one thread
+(``wf/multipipe.hpp:537-590``); the TPU-native analog fuses their
+*programs*. A ``Map_TPU -> Filter_TPU -> Map_TPU`` chain built via
+``MultiPipe.chain`` runs as ONE replica whose per-batch work is a single
+``jax.jit`` program composed from the sub-operators' kernels
+(``ops_tpu.py`` kernel plane):
+
+- a filter's keep mask flows to the next sub-op as a device-side
+  ``valid`` mask — no mid-chain compaction, no mid-chain ``int(count)``
+  readback; the one compaction + count readback happens at the chain
+  exit (or never, for map-only chains);
+- stateful sub-ops contribute their grid tables as additional carried
+  state: the fused program threads every table through and the
+  donation discipline matches the standalone grid scan (tables are
+  donated, every commit reassigns them);
+- a global ``Reduce_TPU`` terminator folds the masked survivors to one
+  tuple inside the same program (``masked_tree_reduce``);
+- the whole chain submits ONE host-prep/device-commit pair to the
+  replica's ``DeviceDispatchQueue`` — three chained operators cost one
+  program launch and one commit per batch instead of three of each
+  plus two channel hops.
+
+Cross-operator XLA fusion then eliminates the intermediate HBM
+materialization between sub-ops (Snider & Liang, arXiv:2301.13062;
+Zheng et al., arXiv:1811.05213): the elementwise map/filter chain
+compiles to one fused loop over the batch.
+
+Compiled programs are cached per chain signature: the cache key covers
+every stateful sub-op's grid shape ``(M, KB)`` (stateless sub-ops pin a
+``None`` slot), and the cache itself lives on the chain's HEAD operator
+so all replicas of the fused stage share one compilation.
+
+Checkpointing: ``snapshot_state`` records the fused signature plus one
+positional entry per sub-op, so PR 3 restores land each grid table back
+into the right sub-op; a blob from a differently-fused (or unfused)
+topology fails loudly instead of silently dropping state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..basic import WindFlowError
+from ..runtime.dispatch import DeviceDispatchQueue
+from .batch import BatchTPU
+from .ops_tpu import (Filter_TPU, Map_TPU, Reduce_TPU, TPUReplicaBase,
+                      _compact_order, _grid_scan_core, _KeyedStateScan,
+                      cached_compile, masked_tree_reduce)
+
+
+class _SubSpec:
+    """One sub-operator's contribution to the fused program: a stateless
+    kernel, a stateful grid-scan engine, or the terminal reduce."""
+
+    __slots__ = ("op", "kind", "kernel", "engine", "func")
+
+    def __init__(self, op, kind: str, kernel: Optional[Callable],
+                 engine: Optional[_KeyedStateScan],
+                 func: Optional[Callable] = None) -> None:
+        self.op = op
+        self.kind = kind  # "map" | "filter" | "smap" | "sfilter" | "reduce"
+        self.kernel = kernel  # stateless composable kernel
+        self.engine = engine  # _KeyedStateScan for stateful sub-ops
+        self.func = func  # user functor for the grid-scan core
+
+
+def _build_specs(replica: "FusedTPUReplica", ops) -> List[_SubSpec]:
+    specs: List[_SubSpec] = []
+    for op in ops:
+        if isinstance(op, Reduce_TPU):
+            if op.key_extractor is not None:
+                raise WindFlowError(
+                    f"{op.name}: keyed Reduce_TPU cannot join a fused "
+                    "device chain (it owns a KEYBY shuffle stage)")
+            specs.append(_SubSpec(op, "reduce", None, None))
+        elif isinstance(op, Map_TPU):
+            if op.state_init is not None:
+                specs.append(_SubSpec(
+                    op, "smap", None,
+                    _KeyedStateScan(replica, op.func, op.state_init,
+                                    False, op=op), func=op.func))
+            else:
+                specs.append(_SubSpec(op, "map", op.device_kernel(), None))
+        elif isinstance(op, Filter_TPU):
+            if op.state_init is not None:
+                specs.append(_SubSpec(
+                    op, "sfilter", None,
+                    _KeyedStateScan(replica, op.pred, op.state_init,
+                                    True, op=op), func=op.pred))
+            else:
+                specs.append(_SubSpec(op, "filter", op.device_kernel(),
+                                      None))
+        else:
+            raise WindFlowError(
+                f"{op.name}: operator kind {type(op).__name__} has no "
+                "composable device kernel (fusion legality should have "
+                "refused this chain)")
+    return specs
+
+
+class FusedTPUReplica(TPUReplicaBase):
+    """One replica running a whole chained device stage as one program.
+
+    Protocol-compatible with any ``TPUReplicaBase``: same dispatch-queue
+    ordering contract, punctuation/EOS handling, latency-stamp
+    propagation (``trace_min/max`` ride the batch through the single
+    program) and barrier-alignment drains — the fused node is simply a
+    bigger per-batch program."""
+
+    def __init__(self, ops, idx: int) -> None:
+        ops = list(ops)
+        super().__init__(ops[0], idx)
+        self.ops = ops
+        self.fused_name = "∘".join(o.name for o in ops)
+        # stats/trace attribution: the fused stage is ONE observable
+        # operator named map∘filter∘map; prep/commit spans + histograms
+        # land on this record
+        self.stats.op_name = self.fused_name
+        self.stats.fused_ops = len(ops)
+        self._span_prep = f"wf:prep:{self.fused_name}"
+        # rebuilt so the commit span label carries the fused name
+        self.dispatch = DeviceDispatchQueue(stats=self.stats)
+        self.specs = _build_specs(self, ops)
+        self._engines = [s.engine for s in self.specs
+                         if s.engine is not None]
+        self._has_filter = any(s.kind in ("filter", "sfilter")
+                               for s in self.specs)
+        self._reduce_combine = (ops[-1].combine
+                                if self.specs[-1].kind == "reduce" else None)
+        if any(s.kind == "reduce" for s in self.specs[:-1]):
+            raise WindFlowError(
+                f"{self.fused_name}: global Reduce_TPU must terminate "
+                "the fused chain")
+        # compiled fused programs shared across this stage's replicas
+        # (the graph build is single-threaded; worker threads only read)
+        head = ops[0]
+        if not hasattr(head, "_fused_prog_cache"):
+            head._fused_prog_cache = {}
+            head._fused_prog_lock = threading.Lock()
+        self._prog_cache = head._fused_prog_cache
+        self._prog_lock = head._fused_prog_lock
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def fused_signature(self) -> List[str]:
+        return [op.name for op in self.ops]
+
+    # -- fused program -----------------------------------------------------
+    def _make(self, statics) -> Callable:
+        """Compose the chain into one jitted program. ``statics`` pins
+        each stateful sub-op's grid shape ``(M, KB)`` (None for
+        stateless slots) — together with the traced shapes it is the
+        full chain signature."""
+        import jax
+        import jax.numpy as jnp
+
+        specs = self.specs
+        has_filter = self._has_filter
+        reduce_combine = self._reduce_combine
+        fused_name = self.fused_name
+
+        def run(fields, size, hargs, tables):
+            n = next(iter(fields.values())).shape[0]
+            valid = jnp.arange(n) < size
+            new_tables = []
+            ti = 0
+            for i, spec in enumerate(specs):
+                if spec.kind in ("map", "filter"):
+                    fields, valid, _ = spec.kernel(fields, valid, None)
+                    if not isinstance(fields, dict):
+                        raise WindFlowError(
+                            f"{fused_name}: Map_TPU function must return "
+                            "a dict of columns")
+                elif spec.kind in ("smap", "sfilter"):
+                    M, KB = statics[i]
+                    core = _grid_scan_core(spec.func,
+                                           spec.kind == "sfilter", M, KB)
+                    grid_idx, touched, tmask = hargs[i]
+                    out, t2 = core(fields, valid, grid_idx, touched,
+                                   tmask, tables[ti])
+                    new_tables.append(t2)
+                    ti += 1
+                    if spec.kind == "sfilter":
+                        valid = out
+                    else:
+                        fields = out
+                # "reduce" handled at the exit below (always last)
+            if reduce_combine is not None:
+                red = masked_tree_reduce(reduce_combine, fields, valid)
+                return (red, _compact_order(valid), jnp.sum(valid),
+                        tuple(new_tables))
+            if has_filter:
+                order = _compact_order(valid)  # keepers first, stable
+                out = {k: v[order] for k, v in fields.items()}
+                return out, order, jnp.sum(valid), tuple(new_tables)
+            return fields, tuple(new_tables)
+
+        # grid tables are DONATED exactly like the standalone scan:
+        # every commit reassigns the engines' tables from the output
+        return jax.jit(run, donate_argnums=(3,))
+
+    # -- batch path --------------------------------------------------------
+    def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
+        # HOST-PREP: per-stateful-sub-op slot mapping + grid assembly
+        # (grid_meta drains the pipeline itself iff a state table must
+        # grow); ONE cached-program lookup for the whole chain
+        statics: List[Any] = []
+        hargs: List[Any] = []
+        for spec in self.specs:
+            if spec.engine is not None:
+                grid_idx, _valid, touched, tmask, M, KB = \
+                    spec.engine.grid_meta(batch)
+                statics.append((M, KB))
+                hargs.append((grid_idx, touched, tmask))
+            else:
+                statics.append(None)
+                hargs.append(None)
+        key = tuple(statics)
+        prog = cached_compile(self._prog_cache, self._prog_lock, key,
+                              lambda: self._make(key))
+        hargs_t = tuple(hargs)
+        engines = self._engines
+
+        def commit() -> None:
+            # tables read AT COMMIT TIME — earlier queued commits
+            # reassign them (donation)
+            tables = tuple(e.table for e in engines)
+            res = prog(batch.fields, batch.size, hargs_t, tables)
+            self.stats.device_programs_run += 1  # ONE program per batch
+            new_tables = res[-1]
+            for eng, t2 in zip(engines, new_tables):
+                eng.table = t2
+            if self._reduce_combine is not None:
+                out, order, count, _ = res
+                n_out = int(count)  # the chain's single exit readback
+                self.stats.inputs_ignored += batch.size - n_out
+                if n_out == 0:
+                    return
+                order_np = np.asarray(order)
+                ts = np.array([int(batch.ts_host[order_np[:n_out]].max())],
+                              dtype=np.int64)
+                nb = BatchTPU(out, ts, 1, batch.schema, batch.wm)
+                nb.stream_tag = batch.stream_tag
+                nb.copy_trace_from(batch)
+                self._emit_batch(nb)
+            elif self._has_filter:
+                out, order, count, _ = res
+                # emit_compacted's int(count)/np.asarray(order) readbacks
+                # run here, depth batches after dispatch
+                self.emit_compacted(batch, out, order, count)
+            else:
+                out, _ = res
+                self._emit_batch(batch.with_fields(out))
+
+        return commit
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()  # drains the dispatch queue
+        st["__fused__"] = self.fused_signature
+        st["fused_sub_states"] = [
+            (spec.engine.snapshot_state() if spec.engine is not None
+             else None)
+            for spec in self.specs]
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        sig = state.get("__fused__")
+        if sig is None:
+            raise WindFlowError(
+                f"restore: this graph fuses {self.fused_name!r} into one "
+                f"device chain, but the checkpoint blob for "
+                f"{self.op.name!r} holds standalone state — the "
+                "checkpointed topology was fused differently (match "
+                "WF_TPU_FUSION / the chain() calls of the original graph)")
+        if list(sig) != self.fused_signature:
+            raise WindFlowError(
+                "restore: fused-chain mismatch — the checkpoint holds "
+                f"{'∘'.join(sig)!r}, this graph builds "
+                f"{self.fused_name!r}")
+        super().restore_state(state)
+        subs = state.get("fused_sub_states")
+        if subs is None or len(subs) != len(self.specs):
+            raise WindFlowError(
+                f"restore: fused chain {self.fused_name!r} expects "
+                f"{len(self.specs)} per-sub-op states, checkpoint holds "
+                f"{0 if subs is None else len(subs)}")
+        # positional restore: entry i belongs to sub-op i
+        for spec, sub in zip(self.specs, subs):
+            if spec.engine is not None:
+                spec.engine.restore_state(sub or {})

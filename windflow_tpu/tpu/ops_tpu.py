@@ -69,6 +69,159 @@ def cached_compile(cache: Dict, lock, key, make):
 
 
 # ---------------------------------------------------------------------------
+# composable kernel plane
+# ---------------------------------------------------------------------------
+# Every device operator's per-batch body is expressed as a kernel of the
+# form ``(fields, valid, carry) -> (fields, valid, carry)`` traced inside
+# ONE ``jax.jit`` program:
+#
+# - ``fields``: the batch's column dict;
+# - ``valid``: the device-side keep mask (row alive at this point of the
+#   chain) — a filter narrows it instead of compacting, so chained
+#   operators compose without intermediate HBM materialization or a
+#   mid-chain ``int(count)`` readback (compaction + count happen once at
+#   the chain exit);
+# - ``carry``: the operator's device state (grid tables for stateful
+#   ops; None for stateless).
+#
+# The standalone replicas below and the fused chain replica
+# (``tpu/fused_ops.py``) share these kernels, so both paths run the
+# same traced math.
+
+
+def op_batch_keys(op, batch: "BatchTPU"):
+    """Per-batch keys for ``op``: host metadata when staged keyed, else
+    the device key column named by a string key extractor. Module-level
+    so fused sub-ops resolve keys with THEIR OWN key fields, not the
+    chain head's."""
+    keys = batch.host_keys
+    if keys is None:
+        field = op.key_field
+        if field is not None:
+            keys = key_column_to_list(batch, field)
+        elif getattr(op, "key_fields", None):
+            from .emitters_tpu import composite_keys_from_device
+            keys = composite_keys_from_device(batch, op.key_fields)
+        else:
+            raise WindFlowError(
+                f"{op.name}: keyed TPU operator needs keyed staging "
+                "(with_key_by on the op) or a field-name key")
+    return keys
+
+
+def op_batch_keys_np(op, batch: "BatchTPU"):
+    """``(keys, keys_arr)`` with at most ONE conversion — the host-prep
+    stage's hot path (see ``TPUReplicaBase.batch_keys_np``)."""
+    keys = batch.host_keys
+    if keys is None and op.key_field is not None \
+            and op.key_field in batch.fields:
+        arr = key_column_np(batch, op.key_field)
+        if arr.dtype.kind in "iu":
+            return arr, arr
+    if keys is None:
+        keys = op_batch_keys(op, batch)
+    return keys, np.asarray(keys)
+
+
+def _grid_scan_core(func, filter_mode: bool, M: int, KB: int):
+    """The keyed grid-scan device core (see ``_KeyedStateScan``): rows
+    scatter to a (KB x M) grid of (key slot, per-key position), a
+    ``lax.scan`` walks the position axis while ``vmap`` covers the keys,
+    and the results gather back to arrival positions. Returns
+    ``core(fields, valid, grid_idx, touched, touched_mask, table) ->
+    (out, table2)`` where ``out`` is the per-row output columns (map
+    mode) or the per-row keep mask ANDed with ``valid`` (filter mode).
+    ``valid`` may be a host bool array (standalone) or a traced
+    device mask (fused chains: rows a mid-chain filter dropped skip the
+    grid and leave their key's state untouched)."""
+    import jax
+    import jax.numpy as jnp
+
+    KM = KB * M
+    tmap = jax.tree_util.tree_map
+
+    def bwhere(ok, new, old):
+        shaped = ok.reshape(ok.shape + (1,) * (new.ndim - ok.ndim))
+        return jnp.where(shaped, new, old).astype(old.dtype)
+
+    def core(fields, valid, grid_idx, touched, touched_mask, table):
+        T_cap = next(iter(jax.tree_util.tree_leaves(table))).shape[0]
+        tsafe = jnp.where(touched_mask, touched, 0)
+        sub = tmap(lambda a: a[tsafe], table)  # (KB, ...)
+        safe = jnp.where(valid, grid_idx, KM)
+        grids = {f: jnp.zeros((KM,), v.dtype).at[safe].set(
+                     v, mode="drop").reshape(KB, M)
+                 for f, v in fields.items()}
+        gmask = jnp.zeros((KM,), bool).at[safe].set(
+            True, mode="drop").reshape(KB, M)
+        vfunc = jax.vmap(func)
+
+        def body(tbl, xs):
+            col, ok = xs  # col: {f: (KB,)}, ok: (KB,)
+            out_col, new_state = vfunc(col, tbl)
+            tbl = tmap(lambda o, nw: bwhere(ok, nw, o), tbl, new_state)
+            return tbl, out_col
+
+        cols = {f: g.T for f, g in grids.items()}  # (M, KB)
+        sub2, outs = jax.lax.scan(body, sub, (cols, gmask.T))
+        tscatter = jnp.where(touched_mask, touched, T_cap)
+        table2 = tmap(
+            lambda a, nw: a.at[tscatter].set(nw, mode="drop"),
+            table, sub2)
+        # gather outputs back to arrival positions: grid (slot, within)
+        slot = grid_idx // M
+        within = jnp.where(valid, grid_idx % M, 0)
+        row_flat = within * KB + jnp.minimum(slot, KB - 1)
+        if filter_mode:
+            keep = outs.reshape(-1)[row_flat]  # (cap,)
+            return keep.astype(bool) & valid, table2
+        out_rows = {f: (o.reshape(M * KB, -1)[row_flat].reshape(
+                        fields[f].shape)
+                        if o.ndim > 2 else o.reshape(-1)[row_flat])
+                    for f, o in outs.items()}
+        return out_rows, table2
+
+    return core
+
+
+def masked_tree_reduce(combine, fields, valid):
+    """Whole-batch fold to one tuple via a masked pairwise tree
+    reduction (log2(cap) fused halving passes — associativity is the
+    contract). ``valid`` gates which rows participate, so a fused
+    chain's filter mask flows straight into the terminal reduce. The
+    result is garbage when no row is valid — callers must skip emission
+    when the valid count is zero."""
+    import jax.numpy as jnp
+
+    n = next(iter(fields.values())).shape[0]
+    # Pad up to a power of two so the halving loop never drops an odd
+    # tail (upstream ops such as Ffat_Windows_TPU emit batches whose
+    # capacity is num_win_per_batch — any user value).
+    m = 1 << max(0, n - 1).bit_length()
+    if m != n:
+        pad = m - n
+        fields = {k: jnp.concatenate(
+            [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+            for k, v in fields.items()}
+        valid = jnp.concatenate([valid, jnp.zeros(pad, bool)])
+    cur = fields
+    vcur = valid
+    length = m
+    while length > 1:
+        half = length // 2
+        a = {k: v[:half] for k, v in cur.items()}
+        b = {k: v[half:half * 2] for k, v in cur.items()}
+        va, vb = vcur[:half], vcur[half:half * 2]
+        merged = combine(a, b)
+        cur = {k: jnp.where(va & vb, merged.get(k, b[k]),
+                            jnp.where(va, a[k], b[k]))
+               for k in cur}
+        vcur = va | vb
+        length = half
+    return {k: v[:1] for k, v in cur.items()}
+
+
+# ---------------------------------------------------------------------------
 # shared replica machinery
 # ---------------------------------------------------------------------------
 class TPUReplicaBase(BasicReplica):
@@ -185,32 +338,12 @@ class TPUReplicaBase(BasicReplica):
         slot identity and the ktable fast path's ``isinstance(key, int)``
         checks still see Python ints. Other dtypes keep the list form
         (their consumers iterate Python keys)."""
-        keys = batch.host_keys
-        if keys is None and self.op.key_field is not None \
-                and self.op.key_field in batch.fields:
-            arr = key_column_np(batch, self.op.key_field)
-            if arr.dtype.kind in "iu":
-                return arr, arr
-        if keys is None:
-            keys = self.batch_keys(batch)
-        return keys, np.asarray(keys)
+        return op_batch_keys_np(self.op, batch)
 
     # per-batch keys: host metadata when staged keyed, else the device key
     # column named by a string key extractor
     def batch_keys(self, batch: BatchTPU):
-        keys = batch.host_keys
-        if keys is None:
-            field = self.op.key_field
-            if field is not None:
-                keys = key_column_to_list(batch, field)
-            elif getattr(self.op, "key_fields", None):
-                from .emitters_tpu import composite_keys_from_device
-                keys = composite_keys_from_device(batch, self.op.key_fields)
-            else:
-                raise WindFlowError(
-                    f"{self.op.name}: keyed TPU operator needs keyed staging "
-                    "(with_key_by on the op) or a field-name key")
-        return keys
+        return op_batch_keys(self.op, batch)
 
     def batch_slots_np(self, batch: BatchTPU):
         """Per-batch dense slot ids (HOST numpy) + slot->key order. Device
@@ -269,6 +402,20 @@ class TPUOperatorBase(BasicOperator):
     def is_chainable(self) -> bool:
         return False
 
+    @property
+    def fusion_role(self) -> Optional[str]:
+        """Device-chain fusion classification (``topology/stage.py``):
+        ``"transform"`` composes mid-chain via its ``device_kernel``;
+        ``"terminator"`` may only end a fused chain; None never fuses
+        (window/mesh operators own their whole stage)."""
+        return None
+
+    def device_kernel(self):
+        """The operator's composable ``(fields, valid, carry) ->
+        (fields, valid, carry)`` kernel (stateless transforms only;
+        stateful ops contribute a grid-scan engine instead)."""
+        raise WindFlowError(f"{self.name}: no composable device kernel")
+
     def configure(self, execution_mode, time_policy) -> None:
         if execution_mode is not ExecutionMode.DEFAULT:
             # reference: GPU operators only in DEFAULT mode (map_gpu.hpp:470-478)
@@ -301,6 +448,21 @@ class Map_TPU(TPUOperatorBase):
         self.func = func
         self.state_init = state_init
 
+    @property
+    def fusion_role(self) -> Optional[str]:
+        return "transform"
+
+    def device_kernel(self):
+        if self.state_init is not None:
+            raise WindFlowError(f"{self.name}: stateful Map_TPU carries a "
+                                "grid-scan engine, not a stateless kernel")
+        func = self.func
+
+        def kernel(fields, valid, carry):
+            return func(fields), valid, carry
+
+        return kernel
+
     def build_replicas(self) -> None:
         cls = StatefulMapTPUReplica if self.state_init is not None \
             else MapTPUReplica
@@ -311,7 +473,14 @@ class MapTPUReplica(TPUReplicaBase):
     def __init__(self, op, idx):
         super().__init__(op, idx)
         import jax
-        self._jitted = jax.jit(op.func)
+
+        kernel = op.device_kernel()
+
+        def run(fields):
+            out, _, _ = kernel(fields, None, None)
+            return out
+
+        self._jitted = jax.jit(run)
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         out = self._jitted(batch.fields)
@@ -334,7 +503,8 @@ class _KeyedStateScan:
     lives in a device-resident (K_cap,) table pytree between batches.
     """
 
-    def __init__(self, replica, func, state_init, filter_mode: bool) -> None:
+    def __init__(self, replica, func, state_init, filter_mode: bool,
+                 op=None) -> None:
         from .keymap import KeySlotMap
         self.replica = replica
         self.func = func
@@ -345,10 +515,13 @@ class _KeyedStateScan:
         self.table_capacity = 64
         # compiled grid-scan programs shared across replicas of the op
         # (keyed by grid shape; the table capacity is read from the table
-        # ARGUMENT at trace time, so growth re-traces automatically)
-        op = replica.op
-        self._cache = op._scan_prog_cache
-        self._cache_lock = op._scan_prog_lock
+        # ARGUMENT at trace time, so growth re-traces automatically).
+        # ``op`` overrides the owner: a fused chain replica hosts one
+        # engine per stateful SUB-operator, each resolving keys and
+        # caching against its own op.
+        self.op = replica.op if op is None else op
+        self._cache = self.op._scan_prog_cache
+        self._cache_lock = self.op._scan_prog_lock
         self.table = None  # pytree of (table_capacity, ...) arrays
 
     # -- device program ----------------------------------------------------
@@ -357,58 +530,24 @@ class _KeyedStateScan:
         (KB x M) where KB = distinct keys in this batch (bucketed), and the
         global state table contributes only its touched rows (gathered in,
         scattered back) — per-batch cost is bounded by the batch, not by
-        the stream's total key cardinality."""
+        the stream's total key cardinality. The traced math lives in the
+        shared ``_grid_scan_core`` kernel; this wrapper adds the
+        standalone exit (compaction for filters) and the jit/donation."""
         import jax
         import jax.numpy as jnp
 
-        KM = KB * M
-        func = self.func
+        core = _grid_scan_core(self.func, self.filter_mode, M, KB)
         filter_mode = self.filter_mode
-        tmap = jax.tree_util.tree_map
-
-        def bwhere(ok, new, old):
-            shaped = ok.reshape(ok.shape + (1,) * (new.ndim - ok.ndim))
-            return jnp.where(shaped, new, old).astype(old.dtype)
 
         def run(fields, grid_idx, valid, touched, touched_mask, table):
-            T_cap = next(iter(jax.tree_util.tree_leaves(table))).shape[0]
-            tsafe = jnp.where(touched_mask, touched, 0)
-            sub = tmap(lambda a: a[tsafe], table)  # (KB, ...)
-            safe = jnp.where(valid, grid_idx, KM)
-            grids = {f: jnp.zeros((KM,), v.dtype).at[safe].set(
-                         v, mode="drop").reshape(KB, M)
-                     for f, v in fields.items()}
-            gmask = jnp.zeros((KM,), bool).at[safe].set(
-                True, mode="drop").reshape(KB, M)
-            vfunc = jax.vmap(func)
-
-            def body(tbl, xs):
-                col, ok = xs  # col: {f: (KB,)}, ok: (KB,)
-                out_col, new_state = vfunc(col, tbl)
-                tbl = tmap(lambda o, nw: bwhere(ok, nw, o), tbl, new_state)
-                return tbl, out_col
-
-            cols = {f: g.T for f, g in grids.items()}  # (M, KB)
-            sub2, outs = jax.lax.scan(body, sub, (cols, gmask.T))
-            tscatter = jnp.where(touched_mask, touched, T_cap)
-            table2 = tmap(
-                lambda a, nw: a.at[tscatter].set(nw, mode="drop"),
-                table, sub2)
-            # gather outputs back to arrival positions: grid (slot, within)
-            slot = grid_idx // M
-            within = jnp.where(valid, grid_idx % M, 0)
-            row_flat = within * KB + jnp.minimum(slot, KB - 1)
+            out, table2 = core(fields, valid, grid_idx, touched,
+                               touched_mask, table)
             if filter_mode:
-                keep = outs.reshape(-1)[row_flat]  # (cap,) bool
-                keep = keep & valid
+                keep = out
                 order = _compact_order(keep)  # keepers first, stable
-                out = {k: v[order] for k, v in fields.items()}
-                return out, order, jnp.sum(keep), table2
-            out_rows = {f: (o.reshape(M * KB, -1)[row_flat].reshape(
-                            fields[f].shape)
-                            if o.ndim > 2 else o.reshape(-1)[row_flat])
-                        for f, o in outs.items()}
-            return out_rows, table2
+                outf = {k: v[order] for k, v in fields.items()}
+                return outf, order, jnp.sum(keep), table2
+            return out, table2
 
         # the state table is DONATED: the touched-row scatter updates it
         # in place instead of copying the whole table every batch (the
@@ -453,7 +592,7 @@ class _KeyedStateScan:
 
         n = batch.size
         cap = batch.capacity
-        keys, keys_arr = self.replica.batch_keys_np(batch)
+        keys, keys_arr = op_batch_keys_np(self.op, batch)
         gslots = self._keymap.slots_of(keys, keys_arr, n)
         self._ensure_table(len(self.slot_of_key))
         if self.table_capacity <= 4 * max(1, n):
@@ -607,6 +746,24 @@ class Filter_TPU(TPUOperatorBase):
         self.pred = pred
         self.state_init = state_init
 
+    @property
+    def fusion_role(self) -> Optional[str]:
+        return "transform"
+
+    def device_kernel(self):
+        if self.state_init is not None:
+            raise WindFlowError(f"{self.name}: stateful Filter_TPU carries "
+                                "a grid-scan engine, not a stateless kernel")
+        pred = self.pred
+
+        def kernel(fields, valid, carry):
+            # narrow the keep mask instead of compacting: chained
+            # operators see the batch at full capacity and the single
+            # chain-exit compaction settles the survivors
+            return fields, valid & pred(fields).astype(bool), carry
+
+        return kernel
+
     def build_replicas(self) -> None:
         cls = (StatefulFilterTPUReplica if self.state_init is not None
                else FilterTPUReplica)
@@ -619,13 +776,13 @@ class FilterTPUReplica(TPUReplicaBase):
         import jax
         import jax.numpy as jnp
 
-        pred = op.pred
+        kernel = op.device_kernel()
 
         def run(fields, size):
             n = next(iter(fields.values())).shape[0]
-            keep = pred(fields) & (jnp.arange(n) < size)
+            fields2, keep, _ = kernel(fields, jnp.arange(n) < size, None)
             order = _compact_order(keep)  # keepers first, stable
-            out = {k: v[order] for k, v in fields.items()}
+            out = {k: v[order] for k, v in fields2.items()}
             return out, order, jnp.sum(keep)
 
         self._jitted = jax.jit(run)
@@ -660,6 +817,13 @@ class Reduce_TPU(TPUOperatorBase):
                          output_batch_size, schema)
         self.combine = combine
 
+    @property
+    def fusion_role(self) -> Optional[str]:
+        # the global fold changes cardinality (batch -> one tuple), so it
+        # can only END a fused chain; keyed reduce owns a KEYBY shuffle
+        # stage and never fuses
+        return "terminator" if self.key_extractor is None else None
+
     def build_replicas(self) -> None:
         cls = (ReduceTPUReplica if self.key_extractor is not None
                else GlobalReduceTPUReplica)
@@ -667,8 +831,8 @@ class Reduce_TPU(TPUOperatorBase):
 
 
 class GlobalReduceTPUReplica(TPUReplicaBase):
-    """Whole-batch fold to one tuple via a masked pairwise tree reduction
-    (log2(cap) fused halving passes — associativity is the contract)."""
+    """Whole-batch fold to one tuple via ``masked_tree_reduce`` (shared
+    with the fused-chain exit, which feeds it the chain's keep mask)."""
 
     def __init__(self, op, idx):
         super().__init__(op, idx)
@@ -679,32 +843,7 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
 
         def run(fields, size):
             n = next(iter(fields.values())).shape[0]
-            valid = jnp.arange(n) < size
-            # Pad up to a power of two so the halving loop never drops an
-            # odd tail (upstream ops such as Ffat_Windows_TPU emit batches
-            # whose capacity is num_win_per_batch — any user value).
-            m = 1 << max(0, n - 1).bit_length()
-            if m != n:
-                pad = m - n
-                fields = {k: jnp.concatenate(
-                    [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
-                    for k, v in fields.items()}
-                valid = jnp.concatenate([valid, jnp.zeros(pad, bool)])
-            cur = fields
-            vcur = valid
-            length = m
-            while length > 1:
-                half = length // 2
-                a = {k: v[:half] for k, v in cur.items()}
-                b = {k: v[half:half * 2] for k, v in cur.items()}
-                va, vb = vcur[:half], vcur[half:half * 2]
-                merged = combine(a, b)
-                cur = {k: jnp.where(va & vb, merged.get(k, b[k]),
-                                    jnp.where(va, a[k], b[k]))
-                       for k in cur}
-                vcur = va | vb
-                length = half
-            return {k: v[:1] for k, v in cur.items()}
+            return masked_tree_reduce(combine, fields, jnp.arange(n) < size)
 
         self._jitted = jax.jit(run)
 
