@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Smoke check for the /metrics export plane.
+"""Smoke check for the /metrics + /trace export plane.
 
 Starts an in-process ``MonitoringServer`` (TCP collector + HTTP), runs a
-tiny source -> map -> sink graph with tracing + latency sampling enabled,
-scrapes ``/metrics`` over real HTTP, and asserts that
+tiny source -> map -> sink graph with tracing + latency sampling + the
+flight recorder enabled, scrapes ``/metrics`` and ``/trace`` over real
+HTTP, and asserts that
 
+- ``/metrics`` returns 503 with a clear body BEFORE any graph report
+  arrives (a scraper must see "not ready", not empty-but-200),
 - the scrape parses as Prometheus text exposition format (every
   non-comment line is ``name{labels} value`` with a float value),
 - the required metric families exist (throughput counters, queue
-  gauges, service + end-to-end latency histograms),
+  gauges, service + end-to-end latency histograms, compile attribution,
+  worker-crash counters),
 - histogram families are internally consistent (cumulative buckets
-  monotone, ``_count`` equals the ``+Inf`` bucket).
+  monotone, ``_count`` equals the ``+Inf`` bucket),
+- ``GET /trace?ms=50`` returns a well-formed Chrome trace-event
+  document (the flight-recorder capture window).
 
 Exit code 0 on success. Wired into the tier-1 suite via
 ``tests/test_latency_tracing.py`` (not a separate CI job).
@@ -23,6 +29,7 @@ import os
 import re
 import sys
 import tempfile
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -36,6 +43,10 @@ REQUIRED_FAMILIES = (
     "windflow_service_latency_usec",
     "windflow_e2e_latency_usec",
     "windflow_reports_total",
+    "windflow_compile_total",
+    "windflow_compile_cache_hits_total",
+    "windflow_compile_seconds_total",
+    "windflow_worker_crashes_total",
 )
 
 _SAMPLE_RE = re.compile(
@@ -87,8 +98,44 @@ def check_histogram_consistency(text: str, family: str) -> list:
     return errors
 
 
-def run_graph_and_scrape() -> str:
-    """Run the tiny graph against a fresh server; return the scrape."""
+_TRACE_PHASES = frozenset("BEXiIMCbnesStfPOND(){}Rcav,")
+
+
+def validate_chrome_trace(doc) -> list:
+    """Schema errors in a Chrome trace-event document (empty = valid):
+    object form with a ``traceEvents`` list whose entries carry a string
+    ``name``, a known one-char ``ph``, integer ``pid``/``tid``, and —
+    for complete (``X``) spans — non-negative numeric ``ts``/``dur``."""
+    errors = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"]
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"event {i}: name missing/not a string")
+        ph = ev.get("ph")
+        if not (isinstance(ph, str) and len(ph) == 1
+                and ph in _TRACE_PHASES):
+            errors.append(f"event {i}: bad phase {ph!r}")
+        for k in ("pid", "tid"):
+            if not isinstance(ev.get(k), int):
+                errors.append(f"event {i}: {k} missing/not an int")
+        if ph == "X":
+            for k in ("ts", "dur"):
+                v = ev.get(k)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(f"event {i}: {k}={v!r} (want >= 0)")
+    return errors
+
+
+def run_graph_and_scrape():
+    """Run the tiny graph against a fresh server; return (metrics text,
+    /trace document, pre-run /metrics status code)."""
     from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph,
                               Sink_Builder, Source_Builder, TimePolicy)
     from windflow_tpu.monitoring.monitor import MonitoringServer
@@ -101,6 +148,15 @@ def run_graph_and_scrape() -> str:
     os.environ["WF_LATENCY_SAMPLE"] = "1"
     os.environ.setdefault("WF_LOG_DIR", tempfile.mkdtemp(prefix="wf_log_"))
     try:
+        # no graph has reported yet: a scrape must say "not ready"
+        # loudly, not hand Prometheus an empty-but-200 exposition
+        try:
+            with urllib.request.urlopen(
+                    f"http://{server.host}:{http_port}/metrics",
+                    timeout=10) as r:
+                pre_status = r.status
+        except urllib.error.HTTPError as e:
+            pre_status = e.code
         def src(shipper):
             for v in range(20_000):
                 shipper.push({"v": v})
@@ -108,6 +164,7 @@ def run_graph_and_scrape() -> str:
         seen = [0]
         g = PipeGraph("check_metrics", ExecutionMode.DEFAULT,
                       TimePolicy.INGRESS_TIME)
+        g.with_flight_recorder()  # /trace must have rings to capture
         g.add_source(Source_Builder(src).with_name("src").build()) \
          .add(Map_Builder(lambda t: {"v": t["v"] * 2})
               .with_name("dbl").build()) \
@@ -133,14 +190,26 @@ def run_graph_and_scrape() -> str:
             ctype = r.headers.get("Content-Type", "")
             text = r.read().decode()
         assert ctype.startswith("text/plain"), f"bad content type {ctype!r}"
-        return text
+        assert "version=0.0.4" in ctype, \
+            f"missing exposition version in content type {ctype!r}"
+        # the flight-recorder capture window (graph finished: the doc is
+        # metadata-only but must still be schema-valid JSON)
+        with urllib.request.urlopen(
+                f"http://{server.host}:{http_port}/trace?ms=50",
+                timeout=10) as r:
+            trace_doc = json.load(r)
+        return text, trace_doc, pre_status
     finally:
         server.close()
 
 
 def main() -> int:
-    text = run_graph_and_scrape()
+    text, trace_doc, pre_status = run_graph_and_scrape()
     problems = []
+    if pre_status != 503:
+        problems.append(f"pre-run /metrics returned {pre_status}, want 503")
+    problems.extend(f"/trace: {e}"
+                    for e in validate_chrome_trace(trace_doc))
     for fam in REQUIRED_FAMILIES:
         if f"\n# TYPE {fam} " not in "\n" + text:
             problems.append(f"missing required family: {fam}")

@@ -37,6 +37,7 @@ class BasicBuilder:
         self._output_batch_size = 0
         self._closing: Optional[Callable] = None
         self._latency_sample: Optional[int] = None
+        self._flightrec_events: Optional[int] = None
 
     def with_name(self, name: str) -> "BasicBuilder":
         self._name = name
@@ -69,10 +70,27 @@ class BasicBuilder:
         self._latency_sample = parse_sample_rate(rate)
         return self
 
+    def with_flight_recorder(self, events: int = 0) -> "BasicBuilder":
+        """Enable the flight recorder for this operator's workers with a
+        ring of ``events`` span events (0 picks ``WF_FLIGHTREC_EVENTS``
+        or the 4096 default). A chained stage uses the largest override
+        among its operators. See ``PipeGraph.with_flight_recorder`` for
+        the graph-wide switch and ``PipeGraph.dump_trace`` /
+        ``GET /trace`` for the export paths."""
+        from .monitoring.flightrec import DEFAULT_EVENTS, env_flightrec_events
+        if events < 0:
+            raise WindFlowError("with_flight_recorder: events must be >= 0")
+        self._flightrec_events = (int(events) if events > 0
+                                  else env_flightrec_events()
+                                  or DEFAULT_EVENTS)
+        return self
+
     def _finish(self, op):
         op.closing_func = self._closing
         if self._latency_sample is not None:
             op.latency_sample = self._latency_sample
+        if self._flightrec_events is not None:
+            op.flightrec_events = self._flightrec_events
         return op
 
 

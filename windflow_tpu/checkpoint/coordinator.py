@@ -179,6 +179,14 @@ class CheckpointCoordinator:
             self.last_duration_s = duration
             self.last_bytes = ent["bytes"]
             self.total_bytes += ent["bytes"]
+        # _finalize runs on the LAST acking worker's thread: its flight
+        # ring (when recording) gets the commit marker, closing the
+        # barrier_open -> align -> snapshot -> commit timeline
+        from ..monitoring.flightrec import thread_recorder
+        rec = thread_recorder()
+        if rec is not None:
+            rec.event("ckpt_commit", duration * 1e6,
+                      {"ckpt_id": ckpt_id, "bytes": ent["bytes"]})
         for fn in listeners:
             try:
                 fn(ckpt_id)

@@ -159,6 +159,18 @@ _PROM_SCALARS = (
     ("windflow_checkpoint_align_stall_seconds_total", "counter",
      "Time multi-input workers stalled aligning checkpoint barriers",
      "Checkpoint_align_stall_usec_total", 1e-6),
+    ("windflow_compile_total", "counter",
+     "XLA (re)trace+compiles of the replica's device programs",
+     "Compile_count", 1),
+    ("windflow_compile_cache_hits_total", "counter",
+     "Device-program calls served by the jit compile cache",
+     "Compile_cache_hits", 1),
+    ("windflow_compile_seconds_total", "counter",
+     "Time spent tracing+compiling device programs",
+     "Compile_usec_total", 1e-6),
+    ("windflow_worker_crashes_total", "counter",
+     "Worker threads that died on an unhandled exception",
+     "Worker_crashes", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -233,6 +245,32 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                      "checkpoints committed by the coordinator")
         lines.append("# TYPE windflow_checkpoints_completed_total counter")
         lines.extend(ckpt_body)
+    # compile attribution: the LAST retrace-triggering abstract signature
+    # per replica as an info-style series (the string rides in a label;
+    # the retrace-storm query is rate(windflow_compile_total) paired with
+    # a churning signature label here)
+    sig_body = []
+    for graph, st in reports.items():
+        if not isinstance(st, dict):
+            continue
+        g = _prom_escape(graph)
+        for op in st.get("Operators", []) or []:
+            o = _prom_escape(op.get("name", "?"))
+            for rep in op.get("replicas", []) or []:
+                sig = rep.get("Compile_last_signature")
+                if not sig:
+                    continue
+                sig_body.append(
+                    f'windflow_compile_last_signature_info{{graph="{g}",'
+                    f'operator="{o}",'
+                    f'replica="{int(rep.get("Replica_id", 0))}",'
+                    f'signature="{_prom_escape(sig)}"}} 1')
+    if sig_body:
+        lines.append("# HELP windflow_compile_last_signature_info Abstract "
+                     "signature that triggered the replica's last XLA "
+                     "retrace")
+        lines.append("# TYPE windflow_compile_last_signature_info gauge")
+        lines.extend(sig_body)
     # merged per-operator histograms
     for fam, help_, field in _PROM_HISTS:
         body = []
@@ -368,7 +406,12 @@ class MonitoringServer:
         GET /json    -> full snapshot (sanitized SVGs)
         GET /graph/<name> -> one graph's latest stats
         GET /metrics -> Prometheus text exposition (counters, queue
-                        gauges, per-operator latency histograms)
+                        gauges, per-operator latency histograms); 503
+                        until the first graph report arrives
+        GET /trace?ms=N -> capture N ms of flight-recorder events from
+                        every in-process graph, returned as Chrome
+                        trace-event JSON (requires the recorder enabled
+                        and the graph running in THIS process)
         GET /plain   -> server-rendered static view (no JS)"""
         import http.server
 
@@ -401,8 +444,31 @@ class MonitoringServer:
                     from .webclient import CLIENT_HTML
                     self._send(200, CLIENT_HTML, "text/html")
                 elif self.path == "/metrics":
-                    self._send(200, prometheus_text(snap),
-                               "text/plain; version=0.0.4; charset=utf-8")
+                    if not snap["reports"]:
+                        # a scraper that lands before the first report
+                        # must see "not ready", not an empty-but-200
+                        # exposition it would record as all-zero series
+                        self._send(503, "no monitoring reports received "
+                                   "yet: graph not running, or "
+                                   "WF_TRACING_ENABLED unset\n",
+                                   "text/plain; charset=utf-8")
+                    else:
+                        self._send(200, prometheus_text(snap),
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                elif self.path.startswith("/trace"):
+                    from urllib.parse import parse_qs, urlparse
+                    from .flightrec import capture_trace
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        ms = float(q.get("ms", ["100"])[0])
+                    except ValueError:
+                        self._send(400, json.dumps(
+                            {"error": "ms must be a number"}))
+                        return
+                    # blocks THIS handler thread for the capture window
+                    # (ThreadingHTTPServer: other endpoints stay live)
+                    self._send(200, json.dumps(capture_trace(ms)))
                 elif self.path == "/json":
                     self._send(200, json.dumps(snap))
                 elif self.path.startswith("/graph/"):

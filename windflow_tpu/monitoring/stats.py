@@ -54,6 +54,18 @@ class StatsRecord:
         # fused into this replica's single per-batch program (0 = not a
         # fused replica)
         "fused_ops",
+        # XLA compile attribution (monitoring/flightrec.instrumented_jit):
+        # (re)traces vs cache hits on the replica's device programs, with
+        # elapsed compile time and the triggering abstract signature
+        "compile_count", "compile_usec_total", "compile_last_us",
+        "compile_last_signature", "compile_cache_hits",
+        # worker crash visibility: a replica chain that died records the
+        # exception here instead of only dying as a silent daemon thread
+        "worker_crashes", "worker_last_error",
+        # flight recorder (monitoring/flightrec.py): the owning worker's
+        # event ring, or None — every note_* hook below appends a span
+        # when present
+        "recorder",
     )
 
     def __init__(self, op_name: str = "", replica_idx: int = 0,
@@ -121,6 +133,15 @@ class StatsRecord:
         self.pipe_depth_max = 0  # emitter-side FIFO high-water mark
         self.worker_idle_ticks = 0
         self.fused_ops = 0  # sub-ops fused into this replica's program
+        # -- compile attribution / crash visibility / flight recorder -------
+        self.compile_count = 0
+        self.compile_usec_total = 0.0
+        self.compile_last_us = 0.0
+        self.compile_last_signature = ""
+        self.compile_cache_hits = 0
+        self.worker_crashes = 0
+        self.worker_last_error = ""
+        self.recorder = None  # FlightRecorder, wired by the Worker
 
     # -- service-time recording (wf/basic_operator.hpp:134-158) -------------
     def start_svc(self) -> None:
@@ -139,6 +160,14 @@ class StatsRecord:
             self._svc_rec = False
             if self.hist_service is not None:
                 self.hist_service.record(per_tuple)
+            # flight-recorder svc span rides the SAME traced-cohort gate
+            # (one bool check already paid): no new per-tuple cost. The
+            # op name is part of the span name: chained operators share
+            # one ring, and an upstream op's svc interval CONTAINS its
+            # inline-chained successors' — per-op names keep each
+            # operator's own spans sequential and the nesting readable
+            if self.recorder is not None:
+                self.recorder.event("svc:" + self.op_name, dt_us, n_tuples)
 
     # -- dispatch-pipeline stages (runtime/dispatch.py) ----------------------
     def note_host_prep(self, us: float) -> None:
@@ -152,6 +181,8 @@ class StatsRecord:
                 us - self.dispatch_host_prep_us)
         if self.hist_prep is not None:
             self.hist_prep.record(us)
+        if self.recorder is not None:
+            self.recorder.event("host_prep", us)
 
     def note_dispatch_commit(self, us: float) -> None:
         self.dispatch_commit_total_us += us
@@ -163,6 +194,8 @@ class StatsRecord:
                 us - self.dispatch_commit_us)
         if self.hist_commit is not None:
             self.hist_commit.record(us)
+        if self.recorder is not None:
+            self.recorder.event("commit", us)
 
     def note_dispatch_depth(self, depth: int) -> None:
         if depth > self.dispatch_depth_max:
@@ -182,6 +215,20 @@ class StatsRecord:
         self.checkpoint_last_snapshot_us = snapshot_us
         self.checkpoint_bytes_total += nbytes
         self.checkpoint_align_total_us += align_us
+        if self.recorder is not None:
+            if align_us > 0:
+                self.recorder.event("barrier_align", align_us)
+            self.recorder.event("ckpt_snapshot", snapshot_us,
+                                {"bytes": nbytes})
+
+    # -- compile attribution (monitoring/flightrec.instrumented_jit) ---------
+    def note_compile(self, us: float, signature: str = "") -> None:
+        """One XLA (re)trace+compile on this replica's device programs:
+        elapsed time and the abstract signature that triggered it."""
+        self.compile_count += 1
+        self.compile_usec_total += us
+        self.compile_last_us = us
+        self.compile_last_signature = signature
 
     # -- latency tracing -----------------------------------------------------
     def note_e2e(self, us: float) -> None:
@@ -234,6 +281,16 @@ class StatsRecord:
             "Checkpoint_bytes_total": self.checkpoint_bytes_total,
             "Checkpoint_align_stall_usec_total": round(
                 self.checkpoint_align_total_us, 1),
+            # XLA compile attribution (flightrec.instrumented_jit wraps
+            # the device plane's jit entry points; 0/"" on CPU replicas)
+            "Compile_count": self.compile_count,
+            "Compile_usec_total": round(self.compile_usec_total, 1),
+            "Compile_last_usec": round(self.compile_last_us, 1),
+            "Compile_last_signature": self.compile_last_signature,
+            "Compile_cache_hits": self.compile_cache_hits,
+            # worker crash visibility (Worker records on its error path)
+            "Worker_crashes": self.worker_crashes,
+            "Worker_last_error": self.worker_last_error,
             "isTerminated": self.is_terminated,
         }
         # -- queue / backpressure plane (0s for sources and fused chains) ---

@@ -81,7 +81,9 @@ function render(snap){
     `<span class=badge>${esc(st.Time_policy)}</span>`+
     `<span class=badge>threads ${st.Threads|0}</span>`+
     `<span class="badge ${st.Dropped_tuples? 'warn':''}">dropped `+
-    `${fmt(st.Dropped_tuples)}</span>`;
+    `${fmt(st.Dropped_tuples)}</span>`+
+    (st.Worker_errors? `<span class="badge warn">crashed `+
+    `${Object.keys(st.Worker_errors).length} worker(s)</span>` : "");
   let total = 0, worstP99 = 0, rows = [];
   opNames = (st.Operators||[]).map(o=>o.name);
   (st.Operators||[]).forEach((o, oi) => {
@@ -99,6 +101,7 @@ function render(snap){
       `<td>${fmt(m("Latency_e2e_p99_usec"))}</td>`+
       `<td>${fmt(m("Queue_len"))}/${fmt(m("Queue_depth_max"))}</td>`+
       `<td>${fmt(s("Device_programs_run"))}</td>`+
+      `<td>${fmt(s("Compile_count"))}/${fmt(s("Compile_cache_hits"))}</td>`+
       `<td>${fmt(s("Staging_pool_hits"))}</td></tr>`);
     if (open.has(o.name))
       for (const x of r)
@@ -112,13 +115,15 @@ function render(snap){
           `<td>${fmt(x.Latency_e2e_p99_usec)}</td>`+
           `<td>${fmt(x.Queue_len)}/${fmt(x.Queue_depth_max)}</td>`+
           `<td>${fmt(x.Device_programs_run)}</td>`+
+          `<td title="${esc(x.Compile_last_signature||"")}">`+
+          `${fmt(x.Compile_count)}/${fmt(x.Compile_cache_hits)}</td>`+
           `<td>${fmt(x.Staging_pool_hits)}</td></tr>`);
   });
   el("ops").innerHTML =
     `<table><tr><th class=l>operator</th><th class=l>kind</th><th>par</th>`+
     `<th>in</th><th>out</th><th>ignored</th><th>tuples/s</th>`+
     `<th>svc µs</th><th>svc p99</th><th>e2e p99</th><th>queue</th>`+
-    `<th>device progs</th><th>pool hits</th></tr>`+
+    `<th>device progs</th><th>compiles/hits</th><th>pool hits</th></tr>`+
     rows.join("")+`</table>`+
     `<div class=muted>click an operator row for per-replica detail; `+
     `queue = occupancy/high-water of the operator's input channel</div>`;

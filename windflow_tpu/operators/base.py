@@ -73,6 +73,10 @@ class BasicOperator:
         # latency-tracing sample interval override (with_latency_tracing);
         # None falls back to WF_LATENCY_SAMPLE (monitoring/tracing.py)
         self.latency_sample: Optional[int] = None
+        # flight-recorder ring capacity override (with_flight_recorder);
+        # None falls back to the graph-level setting, then
+        # WF_FLIGHTREC_EVENTS (monitoring/flightrec.py; 0 = off)
+        self.flightrec_events: Optional[int] = None
         self._used = False  # operators are copied into the pipe; guard reuse
 
     # hooks -----------------------------------------------------------------

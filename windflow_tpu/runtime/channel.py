@@ -22,6 +22,11 @@ from typing import Any, List, Optional, Tuple
 
 from ..basic import DEFAULT_BUFFER_CAPACITY
 from ..message import EOS_SENTINEL
+# flight-recorder spans for blocked puts/gets: recorded into the CALLING
+# thread's own ring (a producer blocks on the consumer's channel, so the
+# channel itself cannot own a single-writer ring); only the already-slow
+# blocked paths ever touch this
+from ..monitoring.flightrec import thread_recorder
 
 
 class Channel:
@@ -64,7 +69,11 @@ class Channel:
                 t0 = time.monotonic_ns()
                 while len(self._q) >= self.capacity:
                     self._not_full.wait()
-                self.blocked_put_ns += time.monotonic_ns() - t0
+                dt = time.monotonic_ns() - t0
+                self.blocked_put_ns += dt
+                rec = thread_recorder()
+                if rec is not None:
+                    rec.event("ch_put_blocked", dt / 1e3)
             self._q.append((ch_idx, msg))
             if len(self._q) > self.depth_max:
                 self.depth_max = len(self._q)
@@ -81,7 +90,11 @@ class Channel:
                     t0 = time.monotonic_ns()
                     while not self._q:
                         self._not_empty.wait()
-                    self.blocked_get_ns += time.monotonic_ns() - t0
+                    dt = time.monotonic_ns() - t0
+                    self.blocked_get_ns += dt
+                    rec = thread_recorder()
+                    if rec is not None:
+                        rec.event("ch_get_blocked", dt / 1e3)
                 item = self._q.popleft()
                 self._not_full.notify()
                 return item
@@ -95,7 +108,14 @@ class Channel:
                         self.blocked_get_ns += time.monotonic_ns() - t0
                         return None
                     self._not_empty.wait(remaining)
-                self.blocked_get_ns += time.monotonic_ns() - t0
+                dt = time.monotonic_ns() - t0
+                self.blocked_get_ns += dt
+                # data arrived after a real wait: span (timeouts return
+                # None above without an event — idle waits would flood
+                # the ring on a quiet stream)
+                rec = thread_recorder()
+                if rec is not None:
+                    rec.event("ch_get_blocked", dt / 1e3)
             item = self._q.popleft()
             self._not_full.notify()
             return item

@@ -80,6 +80,15 @@ class BarrierAligner:
             self.waiting = barrier
             self.arrived = {ch}
             self.align_t0_ns = time.monotonic_ns()
+            # flight-recorder marker: the stall span itself is recorded
+            # by the worker at take() time; this instant marks the OPEN
+            # so the trace shows which channel's barrier arrived first
+            from ..monitoring.flightrec import thread_recorder
+            rec = thread_recorder()
+            if rec is not None:
+                rec.event("barrier_open", 0.0,
+                          {"ckpt_id": getattr(barrier, "ckpt_id", None),
+                           "channel": ch})
         else:
             self.arrived.add(ch)
         return self.live.issubset(self.arrived)

@@ -74,7 +74,10 @@ class DeviceDispatchQueue:
         # the Dispatch_commit stats (prep span lives in the replica)
         self._span_commit = "wf:commit:" + (
             stats.op_name if stats is not None and stats.op_name else "?")
-        self._q: "deque[Callable[[], None]]" = deque()
+        # entries are (commit, enqueue_perf_counter): the enqueue stamp
+        # feeds the flight recorder's dispatch_wait span (how long the
+        # prepared batch sat in the queue before its commit ran)
+        self._q: "deque" = deque()
 
     def __len__(self) -> int:
         return len(self._q)
@@ -89,17 +92,20 @@ class DeviceDispatchQueue:
         if self.stats is not None:
             self.stats.note_host_prep(prep_us)
         if self.depth == 0:
-            self._run(commit)
+            self._run(commit, None)
             return
-        self._q.append(commit)
+        self._q.append((commit, time.perf_counter()))
         # record the PEAK occupancy (post-append, pre-pop): a pipeline
         # running steady-state at full depth overflows on every submit,
         # and recording only the post-pop length would under-report
         # Dispatch_queue_depth_max as never-saturated
         if self.stats is not None:
             self.stats.note_dispatch_depth(len(self._q))
+            rec = self.stats.recorder
+            if rec is not None:
+                rec.event("dispatch_submit", 0.0, len(self._q))
         while len(self._q) > self.depth:
-            self._run(self._q.popleft())
+            self._run(*self._q.popleft())
 
     def drain(self, forced: bool = False) -> None:
         """Commit everything in flight. ``forced=True`` marks an
@@ -108,7 +114,7 @@ class DeviceDispatchQueue:
         if forced and self._q and self.stats is not None:
             self.stats.note_dispatch_stall()
         while self._q:
-            self._run(self._q.popleft())
+            self._run(*self._q.popleft())
 
     def on_idle(self) -> bool:
         """Worker idle tick: a quiet stream must not park prepared
@@ -125,8 +131,13 @@ class DeviceDispatchQueue:
         self._q.clear()
 
     # ------------------------------------------------------------------
-    def _run(self, commit: Callable[[], None]) -> None:
+    def _run(self, commit: Callable[[], None],
+             enq_t: Optional[float] = None) -> None:
         t0 = time.perf_counter()
+        if enq_t is not None and self.stats is not None:
+            rec = self.stats.recorder
+            if rec is not None:
+                rec.event("dispatch_wait", (t0 - enq_t) * 1e6)
         try:
             with device_span(self._span_commit):
                 commit()

@@ -43,12 +43,23 @@ class Worker(threading.Thread):
 
     def __init__(self, wname: str, chain: List[Any],
                  channel: Optional[Channel] = None,
-                 coordinator: Optional[Any] = None) -> None:
+                 coordinator: Optional[Any] = None,
+                 flightrec: Optional[Any] = None) -> None:
         super().__init__(name=wname, daemon=True)
         self.chain = chain
         self.channel = channel
         self.coordinator = coordinator  # CheckpointCoordinator or None
         self.error: Optional[BaseException] = None
+        # flight recorder (monitoring/flightrec.py): this worker's event
+        # ring, shared with every chain node's StatsRecord so the stats
+        # hooks (svc/prep/commit/snapshot) append spans to it
+        self.flightrec = flightrec
+        # crash hook (PipeGraph wires a post-mortem trace dump); the
+        # watchdog needs idle ticks even without idle sinks, so a
+        # blocked-forever-on-input worker still advances its counter
+        self.on_crash: Optional[Any] = None
+        self.force_idle_tick = False
+        self._progress = 0  # channel deliveries + idle ticks (watchdog)
         self._eos_seen = 0
         self._has_coll = hasattr(chain[0], "on_channel_eos")
         # replicas = chain nodes that carry operator state (the collector,
@@ -61,6 +72,11 @@ class Worker(threading.Thread):
             if hasattr(n, "snapshot_state") and hasattr(n, "op") \
                     and not any(n is r for r in self._replicas):
                 self._replicas.append(n)
+        if flightrec is not None:
+            for n in chain:
+                st = getattr(n, "stats", None)
+                if st is not None:
+                    st.recorder = flightrec
         self._aligner: Optional[BarrierAligner] = None
         if coordinator is not None and channel is None and chain:
             # source chain: the source replica injects barriers at tuple
@@ -70,12 +86,24 @@ class Worker(threading.Thread):
                 bind(coordinator, self.checkpoint_now)
 
     def run(self) -> None:
+        if self.flightrec is not None:
+            # blocked channel puts/gets and shared-program compiles find
+            # this thread's ring through the TLS slot
+            from ..monitoring.flightrec import set_thread_recorder
+            set_thread_recorder(self.flightrec)
         try:
             self._process()
             self._retire()
             self._shutdown()
         except BaseException as e:
             self.error = e
+            # crash visibility FIRST (while the ring still holds the
+            # run-up): record the error into the stats plane, then the
+            # post-mortem dump hook — only then unwind
+            try:
+                self._record_crash(e)
+            except BaseException:
+                pass
             # unwind so sibling workers never block on us: swallow the rest
             # of our input, then force EOS downstream
             try:
@@ -86,6 +114,38 @@ class Worker(threading.Thread):
                 self._emergency_eos()
             except BaseException:
                 pass
+
+    def _record_crash(self, e: BaseException) -> None:
+        """The BaseException path used to die as a silent daemon thread;
+        now the exception type + traceback land in ``Worker_last_error``
+        (surfaced by ``PipeGraph.get_stats`` and the
+        ``windflow_worker_crashes_total`` metric family), a ``crash``
+        event enters the flight ring, and the PipeGraph's post-mortem
+        hook dumps the trace."""
+        import traceback
+
+        stats = self._stats()
+        if stats is not None:
+            stats.worker_crashes += 1
+            stats.worker_last_error = "".join(
+                traceback.format_exception(type(e), e, e.__traceback__))
+        if self.flightrec is not None:
+            self.flightrec.event("crash", 0.0,
+                                 f"{type(e).__name__}: {e}")
+        if self.on_crash is not None:
+            self.on_crash(self, e)
+
+    def progress_value(self) -> int:
+        """Monotone liveness counter for the stall watchdog: advances on
+        every channel delivery and idle tick, plus tuples moved by the
+        head replica (a source's loop never returns to ``_process``, and
+        a worker stuck INSIDE one long message would otherwise look
+        live)."""
+        v = self._progress
+        stats = self._stats()
+        if stats is not None:
+            v += stats.inputs_received + stats.outputs_sent
+        return v
 
     # -- normal path -------------------------------------------------------
     def _process(self) -> None:
@@ -123,7 +183,11 @@ class Worker(threading.Thread):
         except ValueError:
             idle_ms = 50.0  # malformed knob must not take down the graph
         # <= 0 disables the tick (a 0 timeout would busy-spin when idle)
-        idle_s = idle_ms / 1e3 if idle_sinks and idle_ms > 0 else None
+        # (the stall watchdog forces the tick even without idle sinks:
+        # a worker parked forever in channel.get would otherwise never
+        # advance its progress counter and read as stalled)
+        idle_s = idle_ms / 1e3 \
+            if (idle_sinks or self.force_idle_tick) and idle_ms > 0 else None
         # back off (up to 16x) when consecutive idle ticks find nothing to
         # drain, so a fully idle graph doesn't wake every worker at 20 Hz
         # on a small host; any real message resets the cadence
@@ -135,6 +199,7 @@ class Worker(threading.Thread):
             backoff = idle_s if idle_s is None else idle_s * min(
                 16, 1 << min(idle_streak, 4))
             item = self.channel.get(backoff)
+            self._progress += 1  # liveness for the stall watchdog
             if item is None:  # idle tick
                 if stats is not None:
                     stats.worker_idle_ticks += 1
@@ -217,6 +282,10 @@ class Worker(threading.Thread):
         if stats is not None:
             stats.note_checkpoint((time.perf_counter() - t0) * 1e6,
                                   nbytes, stall_us)
+        if self.flightrec is not None:
+            self.flightrec.event("ckpt_ack", 0.0,
+                                 {"ckpt_id": barrier.ckpt_id,
+                                  "bytes": nbytes})
 
     def _capture_blobs(self) -> dict:
         blobs = {}

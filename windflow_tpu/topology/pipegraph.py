@@ -50,6 +50,13 @@ class PipeGraph:
         self._started = False
         self._ended = False
         self._monitor = None
+        # flight recorder (monitoring/flightrec.py): per-worker event
+        # rings + the stall watchdog; with_flight_recorder() or the
+        # WF_FLIGHTREC_EVENTS / WF_STALL_SEC env knobs enable them
+        self._flightrec_events: Optional[int] = None
+        self._recorders: List[Any] = []
+        self._watchdog = None
+        self.last_postmortem: Optional[str] = None  # newest dump path
         # aligned-barrier checkpointing (windflow_tpu.checkpoint):
         # enabled via with_checkpointing() or the WF_CKPT_INTERVAL /
         # WF_CKPT_DIR env knobs; restore_from enables it implicitly
@@ -92,6 +99,98 @@ class PipeGraph:
             self._ckpt_dir = store_dir
         self._ckpt_retain = retain
         return self
+
+    # ------------------------------------------------------------------
+    # flight recorder (monitoring/flightrec.py)
+    # ------------------------------------------------------------------
+    def with_flight_recorder(self, events: int = 0) -> "PipeGraph":
+        """Enable the per-worker flight recorder: every worker gets a
+        fixed-size single-writer ring of ``events`` span events
+        (default ``WF_FLIGHTREC_EVENTS`` or 4096). Export via
+        ``dump_trace(path)``, the ``MonitoringServer`` ``GET /trace``
+        window, or the automatic post-mortem on a worker crash /
+        stall-watchdog fire."""
+        if self._started:
+            raise WindFlowError("with_flight_recorder after start()")
+        from ..monitoring.flightrec import (DEFAULT_EVENTS,
+                                            env_flightrec_events)
+        self._flightrec_events = (int(events) if events and events > 0
+                                  else env_flightrec_events()
+                                  or DEFAULT_EVENTS)
+        return self
+
+    def _stage_flightrec_events(self, stage: Stage) -> int:
+        """Ring capacity for one stage's workers: the largest per-op
+        builder override (``with_flight_recorder(events=N)``), else the
+        graph-level setting, else ``WF_FLIGHTREC_EVENTS`` (0 = off)."""
+        from ..monitoring.flightrec import env_flightrec_events
+        per_op = max((op.flightrec_events or 0 for op in stage.ops),
+                     default=0)
+        if per_op > 0:
+            return per_op
+        if self._flightrec_events:
+            return self._flightrec_events
+        return env_flightrec_events()
+
+    def trace_document(self, stacks: bool = False,
+                       extra: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """The graph's flight rings as a Chrome trace-event document
+        (empty ``traceEvents`` when no recorder is enabled)."""
+        from ..monitoring.flightrec import thread_stacks, to_chrome_trace
+        return to_chrome_trace(
+            self._recorders,
+            stacks=thread_stacks() if stacks else None, extra=extra)
+
+    def dump_trace(self, path: str, stacks: bool = False) -> str:
+        """Write the flight-recorder timeline as Chrome/Perfetto trace
+        JSON (loads in ``chrome://tracing`` / https://ui.perfetto.dev).
+        ``stacks=True`` adds ``sys._current_frames()`` for every runtime
+        thread (the post-mortem dumps always do)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.trace_document(stacks=stacks), f)
+        return path
+
+    def _postmortem_path(self, kind: str, wname: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in f"{self.name}_{kind}_{wname}")
+        log_dir = os.environ.get("WF_LOG_DIR", "log")
+        return os.path.join(log_dir, f"{safe}.json")
+
+    def _crash_dump(self, worker, exc: BaseException) -> None:
+        """Automatic post-mortem on a worker death: the whole graph's
+        rings + thread stacks + the traceback, so the runs where a
+        timeline matters most leave evidence behind."""
+        import traceback
+        try:
+            path = self._postmortem_path("crash", worker.name)
+            doc = self.trace_document(stacks=True, extra={
+                "crashedWorker": worker.name,
+                "exception": "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__))})
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            self.last_postmortem = path
+        except Exception:
+            pass  # the dump must never mask the original error
+
+    def _stall_dump(self, wname: str) -> None:
+        """Stall-watchdog fire: same dump shape as a crash, flagged with
+        the stalled worker (its stack shows WHERE it is wedged)."""
+        try:
+            path = self._postmortem_path("stall", wname)
+            doc = self.trace_document(stacks=True,
+                                      extra={"stalledWorker": wname})
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            self.last_postmortem = path
+        except Exception:
+            pass
 
     def trigger_checkpoint(self) -> Optional[int]:
         """Force a checkpoint epoch now (sources inject barriers at their
@@ -438,6 +537,9 @@ class PipeGraph:
 
     def _make_workers(self, stage: Stage) -> None:
         p = stage.parallelism
+        rec_events = self._stage_flightrec_events(stage)
+        from ..monitoring.flightrec import env_stall_sec
+        stall = env_stall_sec()
         for i in range(p):
             chain: List[Any] = []
             channel = None
@@ -457,8 +559,19 @@ class PipeGraph:
                 chain.append(stage.first_op.replicas[i])
             else:
                 chain.extend(op.replicas[i] for op in stage.ops)
+            rec = None
+            if rec_events > 0:
+                from ..monitoring.flightrec import FlightRecorder
+                rec = FlightRecorder(
+                    rec_events, pid_label=stage.describe(),
+                    tid_label=f"{self.name}/{stage.describe()}[{i}]")
+                self._recorders.append(rec)
             w = Worker(f"{self.name}/{stage.describe()}[{i}]", chain, channel,
-                       coordinator=self._coordinator)
+                       coordinator=self._coordinator, flightrec=rec)
+            if rec is not None:
+                w.on_crash = self._crash_dump
+            if stall > 0:
+                w.force_idle_tick = True  # liveness ticks for the watchdog
             stage.workers.append(w)
             self._workers.append(w)
 
@@ -486,6 +599,15 @@ class PipeGraph:
             self._coordinator.start()
         self._started = True
         self._t0 = time.monotonic()
+        # flight-recorder registry (feeds MonitoringServer's /trace) +
+        # the stall watchdog (WF_STALL_SEC > 0, default off)
+        from ..monitoring.flightrec import (StallWatchdog, env_stall_sec,
+                                            register_graph)
+        register_graph(self)
+        stall = env_stall_sec()
+        if stall > 0:
+            self._watchdog = StallWatchdog(self, stall,
+                                           dump_fn=self._stall_dump)
         if env_flag("WF_TRACING_ENABLED"):
             # reference: one MonitoringThread per PipeGraph when tracing
             # (wf/pipegraph.hpp:671-675)
@@ -494,6 +616,8 @@ class PipeGraph:
             self._monitor.start()
         for w in self._workers:
             w.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
 
     def wait_end(self) -> None:
         if not self._started:
@@ -504,6 +628,8 @@ class PipeGraph:
             w.join()
         self._ended = True
         self.elapsed_sec = time.monotonic() - self._t0
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._coordinator is not None:
             self._coordinator.stop()
         if self._monitor is not None:
@@ -573,6 +699,13 @@ class PipeGraph:
         }
         if self._coordinator is not None:
             st["Checkpoints"] = self._coordinator.stats()
+        # crash visibility: a worker that died no longer disappears
+        # silently — its exception surfaces in the final report (the
+        # replica-level Worker_last_error carries the full traceback)
+        errs = {w.name: f"{type(w.error).__name__}: {w.error}"
+                for w in self._workers if w.error is not None}
+        if errs:
+            st["Worker_errors"] = errs
         return st
 
     def dump_stats(self, log_dir: str = "log") -> str:

@@ -47,6 +47,7 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ..basic import WindFlowError
+from ..monitoring.flightrec import instrumented_jit
 from ..runtime.dispatch import DeviceDispatchQueue
 from .batch import BatchTPU
 from .ops_tpu import (Filter_TPU, Map_TPU, Reduce_TPU, TPUReplicaBase,
@@ -202,8 +203,12 @@ class FusedTPUReplica(TPUReplicaBase):
             return fields, tuple(new_tables)
 
         # grid tables are DONATED exactly like the standalone scan:
-        # every commit reassigns the engines' tables from the output
-        return jax.jit(run, donate_argnums=(3,))
+        # every commit reassigns the engines' tables from the output.
+        # instrumented_jit attributes (re)traces to this replica's
+        # Compile_* stats with the chain signature — a fused chain whose
+        # batch shapes churn shows up as a retrace storm in the trace
+        return instrumented_jit(run, self.stats, label=self.fused_name,
+                                donate_argnums=(3,))
 
     # -- batch path --------------------------------------------------------
     def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..basic import ExecutionMode, OpType, RoutingMode, WindFlowError
+from ..monitoring.flightrec import instrumented_jit
 from ..monitoring.tracing import device_span
 from ..operators.base import BasicOperator, BasicReplica
 from ..runtime.dispatch import DeviceDispatchQueue
@@ -307,14 +308,24 @@ class TPUReplicaBase(BasicReplica):
 
     def _emit_batch(self, batch: BatchTPU) -> None:
         self.stats.device_batches_out += 1
+        rec = self.stats.recorder
+        if rec is not None:  # per device batch, not per tuple
+            rec.event("emit", 0.0, batch.size)
         self.emitter.emit_device_batch(batch)
 
     def emit_compacted(self, batch: BatchTPU, out_fields, order, count
                        ) -> None:
         """Emit a compaction result: device columns reordered keep-first,
         host ts/keys reordered to match (shared by the filter paths)."""
+        rec = self.stats.recorder
+        t0 = time.perf_counter() if rec is not None else 0.0
+        # the compaction readbacks: int(count) + the order materialization
+        # block on the program result (this is why commits are deferred)
         new_size = int(count)
         order_np = np.asarray(order)
+        if rec is not None:
+            rec.event("readback", (time.perf_counter() - t0) * 1e6,
+                      {"kept": new_size, "of": batch.size})
         self.stats.inputs_ignored += batch.size - new_size
         ts2 = batch.ts_host[order_np]
         keys2 = None
@@ -472,15 +483,13 @@ class Map_TPU(TPUOperatorBase):
 class MapTPUReplica(TPUReplicaBase):
     def __init__(self, op, idx):
         super().__init__(op, idx)
-        import jax
-
         kernel = op.device_kernel()
 
         def run(fields):
             out, _, _ = kernel(fields, None, None)
             return out
 
-        self._jitted = jax.jit(run)
+        self._jitted = instrumented_jit(run, self.stats, label=op.name)
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         out = self._jitted(batch.fields)
@@ -554,7 +563,8 @@ class _KeyedStateScan:
         # same double-buffer discipline as the FFAT forest — every call
         # site reassigns self.table from the program output, so the
         # consumed buffer is never reused)
-        return jax.jit(run, donate_argnums=(5,))
+        return instrumented_jit(run, self.replica.stats,
+                                label=self.op.name, donate_argnums=(5,))
 
     # -- host side ---------------------------------------------------------
     def _ensure_table(self, n_keys_needed: int) -> None:
@@ -773,7 +783,6 @@ class Filter_TPU(TPUOperatorBase):
 class FilterTPUReplica(TPUReplicaBase):
     def __init__(self, op, idx):
         super().__init__(op, idx)
-        import jax
         import jax.numpy as jnp
 
         kernel = op.device_kernel()
@@ -785,7 +794,7 @@ class FilterTPUReplica(TPUReplicaBase):
             out = {k: v[order] for k, v in fields2.items()}
             return out, order, jnp.sum(keep)
 
-        self._jitted = jax.jit(run)
+        self._jitted = instrumented_jit(run, self.stats, label=op.name)
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         out, order, count = self._jitted(batch.fields, batch.size)
@@ -836,7 +845,6 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
 
     def __init__(self, op, idx):
         super().__init__(op, idx)
-        import jax
         import jax.numpy as jnp
 
         combine = op.combine
@@ -845,7 +853,7 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
             n = next(iter(fields.values())).shape[0]
             return masked_tree_reduce(combine, fields, jnp.arange(n) < size)
 
-        self._jitted = jax.jit(run)
+        self._jitted = instrumented_jit(run, self.stats, label=op.name)
 
     def process_device_batch(self, batch: BatchTPU) -> None:
         if batch.size == 0:
@@ -891,7 +899,7 @@ class ReduceTPUReplica(TPUReplicaBase):
             idx = jnp.nonzero(is_last, size=n, fill_value=n - 1)[0]
             return {k: v[idx] for k, v in scanned.items()}
 
-        self._jitted = jax.jit(run)
+        self._jitted = instrumented_jit(run, self.stats, label=op.name)
 
     def _order_and_slots(self, batch: BatchTPU):
         """(order, sorted slot ids, slot->key map) with ONE sort: int
