@@ -807,9 +807,158 @@ def _mesh_fields(platform: str) -> dict:
         return {}
 
 
+def _surge_mode() -> None:
+    """Traffic-spike scenario (``bench.py --surge``): a Zipf-keyed
+    stream steps from a base rate to 2x mid-run, twice — once with the
+    autoscaler on and once with the topology static. Reports sink-side
+    p99 latency before / during (early surge) / after (late surge, when
+    the autoscaler has reacted) for both runs, plus the measured rescale
+    pause. CPU-plane by construction (the elastic plane is host-side
+    routing; no TPU relay involved). Writes results/surge.json and
+    prints one JSON line."""
+    import threading
+
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Reduce,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.scaling import AutoscalePolicy
+
+    n_keys = int(os.environ.get("WF_SURGE_KEYS", "64"))
+    base_rate = float(os.environ.get("WF_SURGE_RATE", "1500"))
+    phase_s = float(os.environ.get("WF_SURGE_PHASE_SEC", "6"))
+    work_s = float(os.environ.get("WF_SURGE_WORK_USEC", "500")) / 1e6
+    rng = np.random.default_rng(7)
+    # Zipf-skewed key table (rank-weighted, capped to n_keys)
+    ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+    probs = (1.0 / ranks ** 1.2)
+    probs /= probs.sum()
+    key_table = rng.choice(n_keys, size=1 << 16, p=probs)
+
+    def run(autoscale: bool) -> dict:
+        samples = []  # (t_rel, latency_us) at the sink
+        lock = threading.Lock()
+        t_start = [0.0]
+
+        class SurgeSource:
+            """Rate-paced pusher: base rate for one phase, 2x for two
+            phases (the step), stamped with wall-clock push time."""
+
+            def __init__(self):
+                self.pos = 0
+
+            def __call__(self, shipper):
+                t_start[0] = time.monotonic()
+                i = 0
+                while True:
+                    t_rel = time.monotonic() - t_start[0]
+                    if t_rel >= 3 * phase_s:
+                        return
+                    rate = base_rate if t_rel < phase_s else 2 * base_rate
+                    # push a 10-tuple burst, then pace to the target rate
+                    for _ in range(10):
+                        k = int(key_table[i & 0xFFFF])
+                        shipper.push({"key": k, "v": i,
+                                      "t0": time.perf_counter()})
+                        i += 1
+                    self.pos = i
+                    time.sleep(max(0.0, 10 / rate
+                                   - (time.monotonic() - t_start[0]
+                                      - t_rel)))
+
+            def snapshot_position(self):
+                return self.pos
+
+            def restore(self, pos):
+                self.pos = pos
+
+        def hot_step(t, s):
+            # fixed per-tuple service time, sized so parallelism 1
+            # saturates between base and 2x rate — the surge NEEDS the
+            # scale-up. sleep (not a busy-wait): it releases the GIL
+            # like real native/device work would, so replicas overlap
+            # and the starved producer actually builds a queue. The
+            # state is the latest tuple, so the sink (which receives
+            # the emitted state) times the tuple's whole path via t0
+            time.sleep(work_s)
+            return t
+
+        def sink(t):
+            if t is None:
+                return
+            lat = (time.perf_counter() - t["t0"]) * 1e6
+            with lock:
+                samples.append((time.monotonic() - t_start[0], lat))
+
+        import shutil
+        store = os.path.join("results", f"surge_ckpt_{autoscale}")
+        shutil.rmtree(store, ignore_errors=True)
+        g = PipeGraph(f"surge_{'auto' if autoscale else 'static'}",
+                      ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME,
+                      channel_capacity=128)
+        g.with_checkpointing(store_dir=store)
+        if autoscale:
+            g.with_autoscaler(AutoscalePolicy(
+                interval_s=0.25, cooldown_s=3.0, max_parallelism=4,
+                up_blocked_put_ms=20, hysteresis=2, factor=2.0))
+        # Reduce re-emits its state per tuple, so sink latency covers
+        # the whole queue + service path of the bottleneck
+        red = Reduce(hot_step, key_extractor=lambda t: t["key"],
+                     name="hot", parallelism=1)
+        g.add_source(Source_Builder(SurgeSource()).with_name("src")
+                     .build()) \
+            .add(red) \
+            .add_sink(Sink_Builder(sink).with_name("snk").build())
+        g.run()
+        st = g.get_stats()
+        shutil.rmtree(store, ignore_errors=True)  # scratch, not artifact
+
+        def p99(lo, hi):
+            window = sorted(v for t, v in samples if lo <= t < hi)
+            if not window:
+                return 0.0
+            return window[min(len(window) - 1,
+                              int(0.99 * (len(window) - 1)))]
+
+        rs = st.get("Rescales", {})
+        return {
+            "tuples": len(samples),
+            "p99_before_us": round(p99(phase_s * 0.3, phase_s), 1),
+            "p99_surge_early_us": round(p99(phase_s, 1.5 * phase_s), 1),
+            "p99_surge_late_us": round(p99(2 * phase_s, 3 * phase_s), 1),
+            "rescale_events": rs.get("Rescale_events", 0),
+            "rescale_pause_s": rs.get("Rescale_last_pause_s", 0.0),
+            "final_parallelism": [o["parallelism"]
+                                  for o in st["Operators"]
+                                  if o["name"] == "hot"][0],
+        }
+
+    print("surge: static topology run", file=sys.stderr)
+    static = run(False)
+    print("surge: autoscaled run", file=sys.stderr)
+    auto = run(True)
+    recovered = (auto["rescale_events"] >= 1
+                 and auto["p99_surge_late_us"]
+                 < max(1.0, 0.5 * static["p99_surge_late_us"]))
+    result = {
+        "metric": "surge_p99_recovery (cpu-plane)",
+        "zipf_keys": n_keys, "base_rate_tps": base_rate,
+        "phase_sec": phase_s,
+        "static": static, "autoscaled": auto,
+        "autoscaler_recovered_p99": recovered,
+    }
+    os.makedirs("results", exist_ok=True)
+    with open(os.path.join("results", "surge.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--ab":
         _ab_mode(sys.argv[2] if len(sys.argv) > 2 else AB_PIN_SHA)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--surge":
+        _surge_mode()
         return
     fallback = os.environ.get("WF_BENCH_FALLBACK") == "1"
     if not fallback and not _probe_backend():

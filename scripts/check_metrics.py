@@ -47,6 +47,12 @@ REQUIRED_FAMILIES = (
     "windflow_compile_cache_hits_total",
     "windflow_compile_seconds_total",
     "windflow_worker_crashes_total",
+    # elastic rescaling (the run performs one live rescale)
+    "windflow_operator_parallelism",
+    "windflow_rescale_total",
+    "windflow_rescale_last_pause_seconds",
+    "windflow_rescale_last_total_seconds",
+    "windflow_checkpoints_completed_total",
 )
 
 _SAMPLE_RE = re.compile(
@@ -157,21 +163,44 @@ def run_graph_and_scrape():
                 pre_status = r.status
         except urllib.error.HTTPError as e:
             pre_status = e.code
+        import threading
+        import time as _time
+
+        gate = threading.Event()
+        pos = [0]
+
         def src(shipper):
-            for v in range(20_000):
-                shipper.push({"v": v})
+            while pos[0] < 20_000:
+                if pos[0] == 10_000:
+                    gate.wait(20)
+                shipper.push({"v": pos[0]})
+                pos[0] += 1
+
+        src.snapshot_position = lambda: pos[0]
+        src.restore = lambda p: pos.__setitem__(0, p)
 
         seen = [0]
         g = PipeGraph("check_metrics", ExecutionMode.DEFAULT,
                       TimePolicy.INGRESS_TIME)
         g.with_flight_recorder()  # /trace must have rings to capture
+        # one live rescale mid-run so the windflow_rescale_* and
+        # operator-parallelism families have real samples to validate
+        g.with_checkpointing(
+            store_dir=tempfile.mkdtemp(prefix="wf_ckpt_"))
         g.add_source(Source_Builder(src).with_name("src").build()) \
          .add(Map_Builder(lambda t: {"v": t["v"] * 2})
               .with_name("dbl").build()) \
          .add_sink(Sink_Builder(
              lambda t: seen.__setitem__(0, seen[0] + 1) if t else None)
              .with_name("out").build())
-        g.run()
+        g.start()
+        deadline = _time.monotonic() + 15
+        while pos[0] < 10_000 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        threading.Timer(0.2, gate.set).start()
+        rep = g.rescale("dbl", 2, timeout_s=20)
+        assert rep.changed and rep["pause_s"] > 0, rep
+        g.wait_end()
         assert seen[0] == 20_000, f"sink saw {seen[0]} tuples"
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land

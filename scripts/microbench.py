@@ -676,7 +676,75 @@ def bench_cpu_plane() -> None:
     report("cpu_plane_3op_chain", N / (time.perf_counter() - t0))
 
 
+def bench_rescale() -> None:
+    """--rescale: the stop-the-world pause of a live rescale
+    (quiesce -> resume, RescaleReport.pause_s) as a function of keyed
+    state size. A keyed Reduce is pre-loaded with K distinct keys
+    (checkpointed state = K per-key accumulators plus blob framing),
+    then rescaled 2 -> 3 mid-stream; the pause covers barrier alignment,
+    teardown, rebuild, repartitioned restore, and worker restart. Gate:
+    REPORT the curve (pause scales with state bytes by construction —
+    blobs are written and re-read through the store); there is no
+    regression threshold."""
+    import shutil
+    import tempfile
+    import threading
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Reduce,
+                              Sink_Builder, Source_Builder, TimePolicy)
+
+    REPS = int(os.environ.get("WF_MB_RESCALE_REPS", "3"))
+
+    def one(n_keys: int) -> tuple:
+        gate = threading.Event()
+        pos = [0]
+        n = n_keys * 4 + 4000
+
+        def src(shipper):
+            while pos[0] < n:
+                # first pass registers every key (the state to move)
+                if pos[0] == n_keys * 2:
+                    gate.wait(30)
+                shipper.push({"k": pos[0] % n_keys, "v": 1})
+                pos[0] += 1
+        src.snapshot_position = lambda: pos[0]
+        src.restore = lambda p: pos.__setitem__(0, p)
+
+        store = tempfile.mkdtemp(prefix="wf_mb_rescale_")
+        g = PipeGraph(f"mb_rescale_{n_keys}", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        red = Reduce(lambda t, s: (0 if s is None else s) + t["v"],
+                     key_extractor=lambda t: t["k"], name="red",
+                     parallelism=2)
+        g.add_source(Source_Builder(src).with_name("src").build()) \
+            .add(red) \
+            .add_sink(Sink_Builder(lambda t: None).with_name("snk")
+                      .build())
+        g.start()
+        while pos[0] < n_keys * 2:
+            time.sleep(0.005)
+        threading.Timer(0.1, gate.set).start()
+        rep = g.rescale("red", 3, timeout_s=60)
+        g.wait_end()
+        shutil.rmtree(store, ignore_errors=True)
+        return rep["pause_s"], rep["total_s"]
+
+    for n_keys in (100, 10_000, 100_000):
+        pauses = []
+        totals = []
+        for _ in range(REPS):
+            p, t = one(n_keys)
+            pauses.append(p)
+            totals.append(t)
+        report(f"rescale_pause_{n_keys}_keys", min(pauses) * 1e3, "ms")
+        report(f"rescale_total_{n_keys}_keys", min(totals) * 1e3, "ms")
+
+
 def main() -> None:
+    if "--rescale" in sys.argv[1:]:
+        bench_rescale()
+        return
     if "--dispatch" in sys.argv[1:]:
         bench_dispatch()
         return

@@ -194,6 +194,75 @@ def test_recovery_smoke_keyed_windows(tmp_path):
     assert len(golden) > 0
 
 
+def test_kill_during_rescale_pre_checkpoint_restorable(tmp_path,
+                                                       monkeypatch):
+    """Crash injected in the middle of a LIVE rescale — after the old
+    runtime plane is torn down, before the new one exists (the worst
+    point). The rescale's own aligned checkpoint must remain restorable
+    at the ORIGINAL parallelism: golden == crashed-prefix + restored."""
+    import threading
+    import time
+
+    n, nk = 3000, 7
+    store = str(tmp_path / "store")
+
+    def build(results, src):
+        g = PipeGraph("ck_rescale_kill", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        kw = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                           key_extractor=lambda t: t["k"],
+                           win_len=6, slide_len=6, win_type=WinType.CB,
+                           name="kw", parallelism=2)
+
+        def sink(r):
+            if r is not None:
+                results[(r.key, r.wid)] = r.value
+        g.add_source(Source_Builder(src).with_name("src").build()) \
+            .add(kw) \
+            .add_sink(Sink_Builder(sink).with_name("snk").build())
+        return g
+
+    golden = {}
+    build(golden, ReplaySource(n, nk)).run()
+
+    crash_res = {}
+    gate = threading.Event()
+
+    class GatedSource(ReplaySource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.pos == 1400:
+                    gate.wait(20)
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": v})
+                self.pos += 1
+
+    src = GatedSource(n, nk)
+    g = build(crash_res, src)
+    g.start()
+    while src.pos < 1400:
+        time.sleep(0.01)
+    monkeypatch.setattr(
+        PipeGraph, "_rebuild_runtime",
+        lambda self: (_ for _ in ()).throw(
+            InjectedCrash("killed mid-rescale")))
+    threading.Timer(0.2, gate.set).start()
+    with pytest.raises(InjectedCrash):
+        g.rescale("kw", 4, timeout_s=30)
+    monkeypatch.undo()
+    # the rescale's aligned checkpoint committed before the kill
+    assert g._coordinator.completed >= 1
+    cid = g._coordinator.last_completed_id
+
+    restore_res = {}
+    g2 = build(restore_res, ReplaySource(n, nk))
+    g2.run(restore_from=store)
+    assert CheckpointStore.resolve(store)[0] >= cid
+    merged = {**crash_res, **restore_res}
+    assert merged == golden
+
+
 def test_recovery_smoke_records_checkpoint_stats(tmp_path):
     store = str(tmp_path / "store")
     res = {}

@@ -1,0 +1,579 @@
+"""Elastic rescaling (windflow_tpu.scaling): live N->M repartitioning of
+keyed state driven by the checkpoint plane, plus the autoscaler policy.
+
+The load-bearing invariant everywhere: a pipeline rescaled mid-stream
+produces results IDENTICAL to an uninterrupted run — repartitioning moves
+every key's state to exactly the replica the KEYBY emitters route that
+key to, sources resume from their barrier positions (no source-zero
+replay), and nothing buffered at the barrier is lost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from windflow_tpu import (AutoscalePolicy, ExecutionMode, Keyed_Windows,
+                          PipeGraph, Reduce, Sink_Builder, Source_Builder,
+                          TimePolicy, WindFlowError, WinType)
+
+
+class PacedSource:
+    """Replayable source with a gate: blocks once at ``gate_at`` so tests
+    can rescale at a deterministic stream position, and keeps pushing
+    (slowly) afterwards so barriers always find a push boundary."""
+
+    def __init__(self, n, gate_at=None, n_keys=13, gate=None):
+        self.n = n
+        self.n_keys = n_keys
+        self.gate_at = gate_at
+        self.gate = gate
+        self.pos = 0
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.pos == self.gate_at and self.gate is not None:
+                self.gate.wait(30)
+            shipper.push({"key": self.pos % self.n_keys, "v": self.pos})
+            self.pos += 1
+            if self.pos % 400 == 0:
+                time.sleep(0.001)
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+def _collecting_sink(results, lock):
+    def sink(r):
+        if r is not None:
+            with lock:
+                results.append(r)
+    return sink
+
+
+def _run_keyed_windows(tmp_path, par0, rescale_to=None, n=5000,
+                       gate_at=2200, sink_par=2, n_keys=13):
+    """source -> Keyed_Windows(par0) -> sink(2); optionally live-rescale
+    the window stage to ``rescale_to`` at stream position ``gate_at``.
+    Returns (sorted results, RescaleReport | None)."""
+    results, lock = [], threading.Lock()
+    gate = threading.Event() if rescale_to is not None else None
+    src = PacedSource(n, gate_at if rescale_to is not None else None,
+                      n_keys, gate)
+    g = PipeGraph(f"rs_{par0}_{rescale_to}", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / f"st_{par0}_{rescale_to}"))
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    kw = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                       key_extractor=lambda t: t["key"],
+                       win_len=7, slide_len=3, win_type=WinType.CB,
+                       name="kw", parallelism=par0)
+    snk = _collecting_sink(results, lock)
+    p.add(kw).add_sink(
+        Sink_Builder(lambda r: snk(None if r is None
+                                   else (r.key, r.wid, r.value)))
+        .with_name("snk").with_parallelism(sink_par).build())
+    rep = None
+    if rescale_to is None:
+        g.run()
+    else:
+        g.start()
+        deadline = time.monotonic() + 20
+        while src.pos < gate_at and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # release the gate shortly after the rescale barrier goes out so
+        # the parked source reaches its next push boundary and injects
+        threading.Timer(0.2, gate.set).start()
+        rep = g.rescale("kw", rescale_to, timeout_s=30)
+        g.wait_end()
+    return sorted(results), rep
+
+
+# ---------------------------------------------------------------------------
+# the acceptance invariant: live rescale == uninterrupted run
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("rescale_to", [3, 1, 5])
+def test_live_rescale_keyed_windows_identical(tmp_path, rescale_to):
+    base, _ = _run_keyed_windows(tmp_path, 2)
+    got, rep = _run_keyed_windows(tmp_path, 2, rescale_to=rescale_to)
+    assert got == base
+    assert rep.changed
+    assert rep["old_parallelism"] == 2
+    assert rep["new_parallelism"] == rescale_to
+    # downtime is measured and reported
+    assert rep["pause_s"] > 0 and rep["total_s"] >= rep["pause_s"]
+
+
+def test_repeated_rescale_up_then_down(tmp_path):
+    """Two rescales in one run (2 -> 4 -> 1): every transition restores
+    the repartitioned state consistently."""
+    results, lock = [], threading.Lock()
+    gate = threading.Event()
+    src = PacedSource(6000, 1500, 11, gate)
+    g = PipeGraph("rs_multi", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "multi"))
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    red = Reduce(lambda t, s: (0 if s is None else s) + t["v"],
+                 key_extractor=lambda t: t["key"], name="red",
+                 parallelism=2)
+    snk = _collecting_sink(results, lock)
+    p.add(red).add_sink(Sink_Builder(snk).with_name("snk").build())
+    g.start()
+    while src.pos < 1500:
+        time.sleep(0.01)
+    threading.Timer(0.2, gate.set).start()
+    r1 = g.rescale("red", 4, timeout_s=30)
+    r2 = g.rescale("red", 1, timeout_s=30)
+    g.wait_end()
+    assert r1.changed and r2.changed
+    # Reduce emits the running per-key sum after every tuple: the result
+    # multiset of an uninterrupted run is fully determined by the stream
+    base = []
+    per_key = {}
+    for pos in range(6000):
+        k = pos % 11
+        per_key[k] = per_key.get(k, 0) + pos
+        base.append(per_key[k])
+    assert sorted(results) == sorted(base)
+    st = g.get_stats()
+    assert st["Rescales"]["Rescale_events"] == 2
+    red_entry = [o for o in st["Operators"] if o["name"] == "red"][0]
+    assert red_entry["parallelism"] == 1
+
+
+# ---------------------------------------------------------------------------
+# refusals: non-repartitionable state fails loudly, graph unharmed
+# ---------------------------------------------------------------------------
+def test_rescale_refusals(tmp_path):
+    from windflow_tpu import Parallel_Windows
+    from windflow_tpu.scaling import repartition_refusal
+
+    pw = Parallel_Windows(lambda rows: len(rows), lambda t: t["key"],
+                          win_len=4, slide_len=4, win_type=WinType.TB,
+                          name="pw", parallelism=2)
+    assert "BROADCAST" in repartition_refusal(pw)
+
+    from windflow_tpu.operators.source import Source
+    s = Source(lambda sh: None, name="s")
+    assert "cursor" in repartition_refusal(s)
+
+    from windflow_tpu import Interval_Join
+    from windflow_tpu.basic import JoinMode
+    dp = Interval_Join(lambda a, b: a, lambda t: t["key"], -5, 5,
+                       name="dpj", parallelism=2, join_mode=JoinMode.DP)
+    # DP join is BROADCAST-routed, so either refusal reason is correct
+    assert repartition_refusal(dp) is not None
+    kp = Interval_Join(lambda a, b: a, lambda t: t["key"], -5, 5,
+                       name="kpj", parallelism=2, join_mode=JoinMode.KP)
+    assert repartition_refusal(kp) is None
+
+    from windflow_tpu.persistent import P_Reduce_Builder
+    pr = (P_Reduce_Builder(lambda t, s: (s or 0) + 1)
+          .with_key_by(lambda t: t["key"])
+          .with_db_path(str(tmp_path / "db")).build())
+    assert "sqlite" in repartition_refusal(pr) \
+        or "persistent" in repartition_refusal(pr)
+
+
+def test_rescale_refusal_is_loud_and_graph_survives(tmp_path):
+    """A refused rescale raises BEFORE any barrier is triggered; the
+    graph keeps running and finishes normally."""
+    results, lock = [], threading.Lock()
+    src = PacedSource(1200, None, 7)
+    g = PipeGraph("rs_refuse", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "refuse"))
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    red = Reduce(lambda t, s: (0 if s is None else s) + 1,
+                 key_extractor=lambda t: t["key"], name="red")
+    snk = _collecting_sink(results, lock)
+    p.add(red).add_sink(Sink_Builder(snk).with_name("snk").build())
+    g.start()
+    with pytest.raises(WindFlowError, match="cursor"):
+        g.rescale("src", 2)
+    with pytest.raises(WindFlowError, match="no operator named"):
+        g.rescale("nope", 2)
+    g.wait_end()
+    assert len(results) == 1200
+
+
+def test_rescale_refuses_non_replayable_source(tmp_path):
+    """A live rescale restores every source from its barrier position; a
+    functor without a cursor would silently replay from zero. Refuse
+    loudly BEFORE any barrier goes out."""
+    release = threading.Event()
+
+    def no_cursor(shipper):
+        for i in range(100):
+            shipper.push({"key": i % 3, "v": i})
+        release.wait(10)
+
+    g = PipeGraph("rs_noreplay", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "nr"))
+    p = g.add_source(Source_Builder(no_cursor).with_name("src").build())
+    red = Reduce(lambda t, s: (s or 0) + 1,
+                 key_extractor=lambda t: t["key"], name="red")
+    p.add(red).add_sink(Sink_Builder(lambda t: None).build())
+    g.start()
+    try:
+        with pytest.raises(WindFlowError, match="not replayable"):
+            g.rescale("red", 2)
+    finally:
+        release.set()
+        g.wait_end()
+
+
+def test_rescale_requires_checkpointing():
+    g = PipeGraph("rs_nockpt", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    src = PacedSource(50, None, 3)
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    red = Reduce(lambda t, s: (s or 0) + 1,
+                 key_extractor=lambda t: t["key"], name="red")
+    p.add(red).add_sink(Sink_Builder(lambda t: None).build())
+    g.start()
+    try:
+        with pytest.raises(WindFlowError, match="checkpoint"):
+            g.rescale("red", 2)
+    finally:
+        g.wait_end()
+
+
+# ---------------------------------------------------------------------------
+# coordinator epoch timeout (WF_CKPT_TIMEOUT satellite)
+# ---------------------------------------------------------------------------
+def test_checkpoint_timeout_names_unacked_workers(tmp_path):
+    """A worker that never acks (source wedged before any push boundary)
+    fails the epoch with a descriptive error instead of hanging."""
+    release = threading.Event()
+
+    def wedged(shipper):
+        release.wait(15)
+        shipper.push({"key": 0, "v": 1})
+
+    g = PipeGraph("rs_timeout", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "to"))
+    p = g.add_source(Source_Builder(wedged).with_name("wedge").build())
+    p.add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+    g.start()
+    try:
+        with pytest.raises(WindFlowError) as ei:
+            g.trigger_checkpoint(wait=True, timeout_s=0.5)
+        msg = str(ei.value)
+        assert "timed out" in msg and "never acked" in msg
+        assert "wedge" in msg  # the wedged source worker is named
+        assert g._coordinator.failed_epochs == 1
+        assert "Checkpoint_last_failure" in g._coordinator.stats()
+    finally:
+        release.set()
+        g.wait_end()
+
+
+def test_rescale_timeout_aborts_and_graph_continues(tmp_path):
+    """A rescale whose quiesce times out releases the parked workers
+    with 'resume': the stream completes on the OLD topology."""
+    release = threading.Event()
+    results, lock = [], threading.Lock()
+
+    def half_wedged(shipper):
+        for i in range(300):
+            shipper.push({"key": i % 5, "v": i})
+        release.wait(15)  # barrier cannot inject while parked here
+        for i in range(300, 600):
+            shipper.push({"key": i % 5, "v": i})
+
+    half_wedged.snapshot_position = lambda: 0
+    half_wedged.restore = lambda pos: None
+
+    g = PipeGraph("rs_abort", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "abort"))
+    p = g.add_source(Source_Builder(half_wedged).with_name("src").build())
+    red = Reduce(lambda t, s: (s or 0) + 1,
+                 key_extractor=lambda t: t["key"], name="red",
+                 parallelism=2)
+    snk = _collecting_sink(results, lock)
+    p.add(red).add_sink(Sink_Builder(snk).with_name("snk").build())
+    g.start()
+    time.sleep(0.2)
+    with pytest.raises(WindFlowError, match="timed out|quiesce"):
+        g.rescale("red", 3, timeout_s=0.6)
+    release.set()
+    g.wait_end()
+    assert len(results) == 600
+    red_entry = [o for o in g.get_stats()["Operators"]
+                 if o["name"] == "red"][0]
+    assert red_entry["parallelism"] == 2  # unchanged: rescale aborted
+    assert g.get_stats()["Rescales"]["Rescale_failures"] == 1
+
+
+# ---------------------------------------------------------------------------
+# monitoring: series retirement, /metrics families, report block
+# ---------------------------------------------------------------------------
+def test_scale_down_retires_series_mark_final_then_drop(tmp_path):
+    results, lock = [], threading.Lock()
+    gate = threading.Event()
+    src = PacedSource(3000, 1200, 9, gate)
+    g = PipeGraph("rs_retire", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "ret"))
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    red = Reduce(lambda t, s: (0 if s is None else s) + t["v"],
+                 key_extractor=lambda t: t["key"], name="red",
+                 parallelism=3)
+    snk = _collecting_sink(results, lock)
+    p.add(red).add_sink(Sink_Builder(snk).with_name("snk").build())
+    g.start()
+    while src.pos < 1200:
+        time.sleep(0.01)
+    threading.Timer(0.2, gate.set).start()
+    g.rescale("red", 1, timeout_s=30)
+    # first stats call: replicas 1/2 appear once more, marked Final
+    st = g.get_stats()
+    retired = [o for o in st["Operators"] if o.get("retired")]
+    assert retired and retired[0]["name"] == "red"
+    final_ids = sorted(r["Replica_id"] for r in retired[0]["replicas"])
+    assert final_ids == [1, 2]
+    assert all(r["Final"] for r in retired[0]["replicas"])
+    # second stats call: dropped (clean series end, not a frozen value)
+    st2 = g.get_stats()
+    assert not [o for o in st2["Operators"] if o.get("retired")]
+    g.wait_end()
+
+    # /metrics renders the rescale families off the report block
+    from windflow_tpu.monitoring.monitor import prometheus_text
+    text = prometheus_text({"reports": {g.name: g.get_stats()},
+                            "n_reports": 1})
+    assert "windflow_operator_parallelism" in text
+    assert 'windflow_rescale_total{graph="rs_retire"} 1' in text
+    assert "windflow_rescale_last_pause_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# device plane: grid-scan state table repartition (runs on CPU backend)
+# ---------------------------------------------------------------------------
+def test_live_rescale_stateful_map_tpu(tmp_path):
+    import jax.numpy as jnp
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n_keys, per_key = 6, 400
+    acc, lock = {}, threading.Lock()
+    counted = [0]
+    gate = threading.Event()
+
+    class ColSource:
+        def __init__(self):
+            self.pos = 0
+
+        def __call__(self, shipper):
+            while self.pos < per_key:
+                if self.pos == per_key // 2:
+                    gate.wait(30)
+                v = self.pos + 1
+                for k in range(n_keys):
+                    shipper.push({"key": k, "value": v})
+                self.pos += 1
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    src_f = ColSource()
+
+    def step(row, state):
+        s2 = {"total": state["total"] + row["value"]}
+        return {**row, "value": s2["total"]}, s2
+
+    g = PipeGraph("rs_tpu", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "tpu"))
+    src = (Source_Builder(src_f).with_name("src")
+           .with_output_batch_size(16).build())
+    m = (Map_TPU_Builder(step).with_key_by("key")
+         .with_state({"total": jnp.int32(0)})
+         .with_name("smap").with_parallelism(2).build())
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                acc[t["key"]] = max(acc.get(t["key"], 0), t["value"])
+                counted[0] += 1
+
+    g.add_source(src).add(m).add_sink(
+        Sink_Builder(sink).with_name("snk").build())
+    g.start()
+    while src_f.pos < per_key // 2:
+        time.sleep(0.01)
+    threading.Timer(0.3, gate.set).start()
+    rep = g.rescale("smap", 3, timeout_s=60)
+    g.wait_end()
+    assert rep.changed
+    total = per_key * (per_key + 1) // 2
+    # a lost/misrouted state table would restart some key's running sum
+    assert acc == {k: total for k in range(n_keys)}
+    assert counted[0] == n_keys * per_key
+
+
+def test_live_rescale_ffat_tpu_forest(tmp_path):
+    """FFAT TPU forest repartition: per-slot host arrays + device trees
+    gathered along the key axis. CB windows, EVENT_TIME, 1 -> 2."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from common import DictWinCollector, TupleT
+
+    from windflow_tpu import TimePolicy as TP
+    from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+    n_keys, stream_len = 7, 120
+
+    def run(rescale_to=None):
+        coll = DictWinCollector()
+        gate = threading.Event()
+        pos = [0]
+
+        def src(shipper):
+            while pos[0] < stream_len:
+                i = pos[0]
+                if i == stream_len // 2 and rescale_to is not None:
+                    gate.wait(30)
+                ts = i * 50
+                for k in range(n_keys):
+                    shipper.push_with_timestamp(TupleT(k, i + 1 + k, ts),
+                                                ts)
+                shipper.set_next_watermark(ts)
+                pos[0] += 1
+        src.snapshot_position = lambda: pos[0]
+        src.restore = lambda p: pos.__setitem__(0, p)
+
+        g = PipeGraph(f"rs_ffat_tpu_{rescale_to}", ExecutionMode.DEFAULT,
+                      TP.EVENT_TIME)
+        g.with_checkpointing(store_dir=str(tmp_path / f"ft_{rescale_to}"))
+        sb = (Source_Builder(src).with_name("src")
+              .with_output_batch_size(16).build())
+        op = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+              .with_key_by("key").with_cb_windows(9, 4)
+              .with_name("ffat").with_parallelism(1).build())
+        g.add_source(sb).add(op).add_sink(
+            Sink_Builder(coll.sink).with_name("snk").build())
+        if rescale_to is None:
+            g.run()
+            return coll
+        g.start()
+        deadline = time.monotonic() + 20
+        while pos[0] < stream_len // 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        threading.Timer(0.3, gate.set).start()
+        rep = g.rescale("ffat", rescale_to, timeout_s=60)
+        assert rep.changed
+        g.wait_end()
+        return coll
+
+    base = run()
+    got = run(rescale_to=2)
+    assert got.dups == 0
+    assert got.results == base.results
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+def test_autoscale_policy_hysteresis_and_cooldown():
+    p = AutoscalePolicy(interval_s=0.1, cooldown_s=100.0,
+                        max_parallelism=8, up_blocked_put_ms=50,
+                        hysteresis=3, factor=2.0)
+    congested = {"red": {"parallelism": 2, "blocked_put_ms_per_s": 300.0,
+                         "blocked_get_ms_per_s": 0.0,
+                         "tuples_per_s": 1e4}}
+    # hysteresis: two hot windows are not enough
+    assert p.observe(congested, now=1000.0) is None
+    assert p.observe(congested, now=1001.0) is None
+    d = p.observe(congested, now=1002.0)
+    assert d == ("red", 4, d[2])
+    assert "backpressure" in d[2]
+    # cooldown: right after acting, even a hot window is ignored
+    p.note_action(1002.0)
+    assert p.observe(congested, now=1003.0) is None
+    # a streak broken by one quiet window starts over
+    p2 = AutoscalePolicy(cooldown_s=0.0, up_blocked_put_ms=50,
+                         hysteresis=2, factor=2.0)
+    quiet = {"red": {"parallelism": 2, "blocked_put_ms_per_s": 0.0,
+                     "blocked_get_ms_per_s": 0.0, "tuples_per_s": 1e4}}
+    assert p2.observe(congested, 1.0) is None
+    assert p2.observe(quiet, 2.0) is None
+    assert p2.observe(congested, 3.0) is None  # streak restarted
+    d2 = p2.observe(congested, 4.0)
+    assert d2 is not None and d2[1] == 4
+
+
+def test_autoscale_policy_scale_down_idle():
+    p = AutoscalePolicy(cooldown_s=0.0, min_parallelism=1,
+                        down_blocked_get_ms=100, hysteresis=2)
+    idle = {"red": {"parallelism": 3, "blocked_put_ms_per_s": 0.0,
+                    "blocked_get_ms_per_s": 900.0, "tuples_per_s": 10.0}}
+    assert p.observe(idle, 1.0) is None
+    d = p.observe(idle, 2.0)
+    assert d == ("red", 2, d[2]) and "idle" in d[2]
+    # never below min_parallelism
+    at_min = {"red": {"parallelism": 1, "blocked_put_ms_per_s": 0.0,
+                      "blocked_get_ms_per_s": 900.0, "tuples_per_s": 1.0}}
+    p3 = AutoscalePolicy(cooldown_s=0.0, min_parallelism=1,
+                         down_blocked_get_ms=100, hysteresis=1)
+    assert p3.observe(at_min, 1.0) is None
+
+
+def test_autoscaler_end_to_end_scales_up_bottleneck(tmp_path):
+    """A deliberately slow keyed operator backpressures its input queue;
+    the autoscaler must scale it up mid-run and the stream completes
+    with exact results."""
+    results, lock = [], threading.Lock()
+    n, n_keys = 2600, 8
+
+    class Src(PacedSource):
+        def __call__(self, shipper):
+            while self.pos < n:
+                shipper.push({"key": self.pos % n_keys, "v": self.pos})
+                self.pos += 1
+
+    src = Src(n, None, n_keys)
+
+    def slow_count(t, s):
+        time.sleep(0.0004)  # ~0.4ms/tuple: the bottleneck
+        return (0 if s is None else s) + 1
+
+    g = PipeGraph("rs_auto", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME, channel_capacity=64)
+    g.with_checkpointing(store_dir=str(tmp_path / "auto"))
+    g.with_autoscaler(AutoscalePolicy(
+        interval_s=0.15, cooldown_s=2.0, max_parallelism=4,
+        up_blocked_put_ms=30, hysteresis=2, factor=2.0))
+    p = g.add_source(Source_Builder(src).with_name("src").build())
+    red = Reduce(slow_count, key_extractor=lambda t: t["key"],
+                 name="red", parallelism=1)
+    snk = _collecting_sink(results, lock)
+    p.add(red).add_sink(Sink_Builder(snk).with_name("snk").build())
+    g.run()
+    st = g.get_stats()
+    assert st["Rescales"]["Rescale_events"] >= 1
+    auto = st["Autoscaler"]
+    assert auto["Autoscaler_decisions"] >= 1
+    assert auto["Autoscaler_history"][0]["op"] == "red"
+    assert auto["Autoscaler_history"][0]["to"] > 1
+    # exact results through however many rescales happened
+    per_key = {}
+    base = []
+    for pos in range(n):
+        k = pos % n_keys
+        per_key[k] = per_key.get(k, 0) + 1
+        base.append(per_key[k])
+    assert sorted(results) == sorted(base)

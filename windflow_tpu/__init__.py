@@ -35,6 +35,7 @@ from .operators.window_engine import WinResult
 from .operators.windows import (Keyed_Windows, MapReduce_Windows,
                                 Paned_Windows, Parallel_Windows)
 from .operators.source import Source, SourceShipper
+from .scaling.autoscaler import AutoscalePolicy
 from .topology.multipipe import MultiPipe
 from .topology.pipegraph import PipeGraph
 
@@ -55,5 +56,6 @@ __all__ = [
     "Keyed_Windows_Builder", "Parallel_Windows_Builder",
     "Paned_Windows_Builder", "MapReduce_Windows_Builder",
     "Ffat_Windows_Builder", "Interval_Join", "Interval_Join_Builder",
+    "AutoscalePolicy",
     "__version__",
 ]

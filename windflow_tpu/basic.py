@@ -118,6 +118,15 @@ class WindFlowError(RuntimeError):
     ``exit(EXIT_FAILURE)``; we raise instead so tests can assert on misuse."""
 
 
+class RescaleTeardown(BaseException):
+    """Internal control-flow signal of the elastic-rescale plane
+    (``windflow_tpu.scaling``): a worker parked at a rescale barrier is
+    told to unwind WITHOUT the EOS cascade — its channels and emitters
+    are about to be rebuilt at the new parallelism. BaseException so user
+    functors' ``except Exception`` handlers cannot swallow it mid-source;
+    ``Worker.run`` catches it explicitly and exits silently."""
+
+
 def as_key_fn(key):
     """Normalize a key extractor: callables pass through; a string names a
     tuple field (works for dataclass attributes and dict keys). String keys

@@ -18,11 +18,28 @@ sees fully-acked checkpoints, which is the correctness contract.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from .store import CheckpointStore
+
+
+def env_ckpt_timeout() -> float:
+    """``WF_CKPT_TIMEOUT`` (seconds): how long an epoch may stay pending
+    before it is failed with a descriptive error naming the unacked
+    workers. 0 / unset = no timeout (the pre-timeout behavior: an epoch
+    that can never complete simply stays uncommitted)."""
+    try:
+        return float(os.environ.get("WF_CKPT_TIMEOUT", "0") or 0)
+    except ValueError:
+        return 0.0  # malformed knob must not take down the graph
+
+
+class EpochFailed(Exception):
+    """Internal marker: an epoch was failed (timeout); ``wait_committed``
+    converts it into the user-facing WindFlowError."""
 
 
 class CheckpointCoordinator:
@@ -64,6 +81,31 @@ class CheckpointCoordinator:
         self.last_duration_s = 0.0
         self.last_bytes = 0
         self.total_bytes = 0
+        # epoch timeout (WF_CKPT_TIMEOUT): pending epochs older than this
+        # fail loudly instead of hanging trigger_checkpoint()/rescale()
+        # forever when a worker never acks
+        self.epoch_timeout_s = env_ckpt_timeout()
+        self.failed_epochs = 0
+        self.last_failure: Optional[str] = None
+        self._failed: Dict[int, str] = {}  # cid -> failure message
+        # wait_committed() sleeps here; notified on finalize and failure
+        self._commit_cond = threading.Condition(self._lock)
+        # worker roster + diagnostics hook, wired by PipeGraph: names make
+        # the timeout error actionable, diagnose() adds Worker_last_error
+        # / stall-watchdog state for the unacked workers when available
+        self.worker_names: List[str] = []
+        self.diagnose: Optional[Callable[[List[str]], str]] = None
+        # rescale hold point (windflow_tpu.scaling): when an epoch is
+        # triggered with hold=True, every worker parks inside
+        # ``checkpoint_now`` right after acking it, so the whole graph
+        # quiesces exactly at the aligned barrier. The controller then
+        # releases them with a directive: "resume" (rescale aborted) or
+        # "abandon" (unwind; the runtime plane is rebuilt)
+        self._hold_epoch: Optional[int] = None
+        self._hold_evt = threading.Event()
+        self._hold_directive = "resume"
+        self.parked: Set[str] = set()
+        self._commit_acked: Dict[int, Set[str]] = {}  # cid -> acked names
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -84,14 +126,20 @@ class CheckpointCoordinator:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
+            self.check_epoch_timeouts()
             self.trigger()
 
     # -- triggering --------------------------------------------------------
-    def trigger(self, force: bool = False) -> Optional[int]:
+    def trigger(self, force: bool = False, hold: bool = False
+                ) -> Optional[int]:
         """Open a new checkpoint epoch and return its id. Without
         ``force``, declines while an earlier checkpoint is still
         in flight (aligned barriers serialize naturally; overlapping
-        epochs would only race each other at the aligners)."""
+        epochs would only race each other at the aligners).
+
+        ``hold=True`` marks the epoch as a rescale quiesce point: every
+        worker parks in ``park_if_held`` right after acking it, until
+        ``release_hold`` hands down a directive."""
         timeout = max(2.0 * (self.interval_s or 0.0), 10.0)
         with self._lock:
             if not force:
@@ -103,6 +151,13 @@ class CheckpointCoordinator:
             cid = self._alloc_id
             self._pending[cid] = {"acked": set(), "bytes": 0,
                                   "t0": time.monotonic()}
+            if hold:
+                # armed BEFORE the epoch publishes: a source may poll the
+                # new requested_id and park before trigger() returns
+                self._hold_epoch = cid
+                self._hold_directive = "resume"
+                self._hold_evt.clear()
+                self.parked = set()
         # stage BEFORE publishing the epoch: sources poll requested_id and
         # may ack immediately — clearing crashed-run debris after that
         # would race their blob writes
@@ -179,6 +234,12 @@ class CheckpointCoordinator:
             self.last_duration_s = duration
             self.last_bytes = ent["bytes"]
             self.total_bytes += ent["bytes"]
+            # the rescale controller needs to know WHO acked a held epoch
+            # (parked ∪ retired must cover them before teardown is safe)
+            self._commit_acked[ckpt_id] = set(ent["acked"])
+            for old in [c for c in self._commit_acked if c < ckpt_id]:
+                self._commit_acked.pop(old, None)
+            self._commit_cond.notify_all()
         # _finalize runs on the LAST acking worker's thread: its flight
         # ring (when recording) gets the commit marker, closing the
         # barrier_open -> align -> snapshot -> commit timeline
@@ -192,6 +253,131 @@ class CheckpointCoordinator:
                 fn(ckpt_id)
             except Exception:  # listener bugs must not kill the worker
                 pass
+
+    # -- epoch timeout (WF_CKPT_TIMEOUT) -----------------------------------
+    def _unacked_of(self, acked: Set[str]) -> List[str]:
+        names = self.worker_names or []
+        return [n for n in names if n not in acked] \
+            or [f"<{self.expected_acks - len(acked)} unnamed worker(s)>"]
+
+    def _fail_epoch_locked(self, cid: int, age_s: float) -> str:
+        """Drop a pending epoch and compose the descriptive error (lock
+        held). The staging dir stays on disk; store.prune cleans it once
+        a newer checkpoint commits. ``diagnose`` (when wired — it only
+        reads already-collected stats) appends per-worker evidence:
+        ``Worker_last_error`` tracebacks, stall-watchdog flags."""
+        ent = self._pending.pop(cid, None)
+        acked = ent["acked"] if ent else set()
+        unacked = self._unacked_of(acked)
+        msg = (f"checkpoint epoch {cid} timed out after {age_s:.1f}s "
+               f"(WF_CKPT_TIMEOUT): {len(acked)}/{self.expected_acks} "
+               f"workers acked; never acked: {', '.join(unacked)}")
+        if self.diagnose is not None:
+            try:
+                extra = self.diagnose(unacked)
+            except Exception:
+                extra = ""
+            if extra:
+                msg += f" — {extra}"
+        self._failed[cid] = msg
+        for old in [c for c in self._failed if c < cid - 16]:
+            self._failed.pop(old, None)
+        self.failed_epochs += 1
+        self.last_failure = msg
+        self._commit_cond.notify_all()
+        return msg
+
+    def check_epoch_timeouts(self) -> None:
+        """Fail pending epochs older than ``WF_CKPT_TIMEOUT``. Called by
+        the interval thread each tick and by ``wait_committed``; a
+        no-op when the timeout is unset."""
+        t = self.epoch_timeout_s
+        if t <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            stale = [(cid, now - ent["t0"])
+                     for cid, ent in self._pending.items()
+                     if now - ent["t0"] >= t]
+            for cid, age in stale:
+                self._fail_epoch_locked(cid, age)
+
+    def wait_committed(self, cid: int, timeout_s: Optional[float] = None
+                       ) -> None:
+        """Block until epoch ``cid`` commits. Raises ``WindFlowError``
+        when the epoch fails (WF_CKPT_TIMEOUT elapsed, or ``timeout_s``
+        as an explicit override) naming the workers that never acked."""
+        from ..basic import WindFlowError
+
+        t = timeout_s if timeout_s is not None else self.epoch_timeout_s
+        deadline = time.monotonic() + t if t and t > 0 else None
+        while True:
+            with self._lock:
+                if self.last_completed_id >= cid:
+                    return
+                if cid in self._failed:
+                    raise WindFlowError(self._failed[cid])
+                if cid not in self._pending:
+                    raise WindFlowError(
+                        f"checkpoint epoch {cid} was dropped without "
+                        "committing (superseded by a newer checkpoint)")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise WindFlowError(
+                        self._fail_epoch_locked(cid, t))
+                self._commit_cond.wait(0.05)
+
+    # -- rescale hold point (windflow_tpu.scaling) -------------------------
+    def park_if_held(self, ckpt_id: int, worker_name: str) -> Optional[str]:
+        """Called by every worker right after acking ``ckpt_id``. For a
+        held (rescale) epoch the worker blocks here — the graph quiesces
+        exactly at the aligned barrier, with every pre-barrier tuple
+        already flushed downstream and nothing post-barrier produced —
+        until the controller releases it. Returns the release directive
+        ("resume" / "abandon"), or None when the epoch is not held."""
+        with self._lock:
+            if self._hold_epoch != ckpt_id:
+                return None
+            self.parked.add(worker_name)
+            self._commit_cond.notify_all()
+            evt = self._hold_evt
+        evt.wait()
+        with self._lock:
+            return self._hold_directive
+
+    def wait_all_parked(self, cid: int, timeout_s: float) -> bool:
+        """True once every worker that acked the held epoch ``cid`` live
+        (i.e. not via retirement) is parked — the moment teardown/rewire
+        is safe. The epoch must already be committed."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                acked = self._commit_acked.get(cid)
+                if acked is not None \
+                        and acked <= (self.parked | set(self._retired)):
+                    return True
+                if time.monotonic() >= deadline:
+                    return False
+                self._commit_cond.wait(0.05)
+
+    def release_hold(self, directive: str = "resume") -> None:
+        """Release every parked worker with ``directive``: "resume"
+        continues processing as after a normal checkpoint (aborted
+        rescale), "abandon" unwinds the worker silently (the runtime
+        plane is being rebuilt)."""
+        with self._lock:
+            self._hold_directive = directive
+            self._hold_epoch = None
+            evt = self._hold_evt
+        evt.set()
+
+    def abort_pending(self) -> None:
+        """Drop every still-pending epoch (rescale teardown: epochs
+        opened against the old runtime plane can never complete once its
+        workers are gone)."""
+        with self._lock:
+            self._pending.clear()
+            self._retired.clear()
+            self._commit_cond.notify_all()
 
     # -- listeners ---------------------------------------------------------
     def add_finalize_listener(self, fn: Callable[[int], None]) -> None:
@@ -210,4 +396,6 @@ class CheckpointCoordinator:
                 "Checkpoint_last_bytes": self.last_bytes,
                 "Checkpoint_bytes_total": self.total_bytes,
                 "Checkpoint_store_dir": self.store.root,
+                "Checkpoint_failed_epochs": self.failed_epochs,
+                "Checkpoint_last_failure": self.last_failure,
             }

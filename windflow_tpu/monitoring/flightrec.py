@@ -330,6 +330,11 @@ class StallWatchdog(threading.Thread):
             self._check(time.monotonic())
 
     def _check(self, now: float) -> None:
+        if getattr(self.graph, "_rescaling", False):
+            # a rescale parks every worker at the barrier on purpose;
+            # re-arm from scratch once the new plane is running
+            self._seen.clear()
+            return
         for w in self.graph._workers:
             if not w.is_alive():
                 self._seen.pop(w.name, None)

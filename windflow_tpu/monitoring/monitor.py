@@ -245,6 +245,56 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                      "checkpoints committed by the coordinator")
         lines.append("# TYPE windflow_checkpoints_completed_total counter")
         lines.extend(ckpt_body)
+    # elastic rescaling (windflow_tpu.scaling): per-operator parallelism
+    # gauge + per-graph rescale counters/timings so a scaling event is a
+    # first-class Prometheus signal
+    par_body = []
+    for graph, st in reports.items():
+        if not isinstance(st, dict):
+            continue
+        g = _prom_escape(graph)
+        for op in st.get("Operators", []) or []:
+            if op.get("retired"):
+                continue  # mark-final replicas end series; no fresh gauge
+            if isinstance(op.get("parallelism"), (int, float)):
+                par_body.append(
+                    f'windflow_operator_parallelism{{graph="{g}",'
+                    f'operator="{_prom_escape(op.get("name", "?"))}"}} '
+                    f'{op["parallelism"]:g}')
+    if par_body:
+        lines.append("# HELP windflow_operator_parallelism Current replica "
+                     "count per operator (changes on rescale)")
+        lines.append("# TYPE windflow_operator_parallelism gauge")
+        lines.extend(par_body)
+    _RESCALE_FAMS = (
+        ("windflow_rescale_total", "counter",
+         "Live rescales completed", "Rescale_events", 1),
+        ("windflow_rescale_failures_total", "counter",
+         "Rescale attempts that aborted", "Rescale_failures", 1),
+        ("windflow_rescale_last_pause_seconds", "gauge",
+         "Stop-the-world pause of the last rescale (quiesce->resume)",
+         "Rescale_last_pause_s", 1),
+        ("windflow_rescale_last_total_seconds", "gauge",
+         "Trigger->resume duration of the last rescale",
+         "Rescale_last_total_s", 1),
+        ("windflow_autoscaler_decisions_total", "counter",
+         "Autoscaler decisions acted on", "Autoscaler_decisions", 1),
+    )
+    for fam, typ, help_, field, scale in _RESCALE_FAMS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            block = st.get("Rescales") if field.startswith("Rescale") \
+                else st.get("Autoscaler")
+            v = (block or {}).get(field)
+            if isinstance(v, (int, float)):
+                body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
+                            f'{v * scale:g}')
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
     # compile attribution: the LAST retrace-triggering abstract signature
     # per replica as an info-style series (the string rides in a label;
     # the retrace-storm query is rate(windflow_compile_total) paired with

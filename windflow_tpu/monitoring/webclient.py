@@ -13,6 +13,10 @@ dependency-free client served by ``MonitoringServer.serve_http``: it polls
 - a second sparkline of the worst sink-side p99 end-to-end latency
   (populated when latency tracing is sampling — WF_LATENCY_SAMPLE /
   with_latency_tracing), plus svc/e2e p99 latency columns,
+- rescale-event markers on the p99 sparkline (dashed ticks where
+  ``Rescale_events`` advanced) plus a rescale badge with the last
+  operator/parallelism/pause — the per-operator ``par`` column is live,
+  so a scaling action is visible the moment it lands,
 - the dataflow SVG diagram (server-sanitized),
 - per-replica drill-down on click.
 """
@@ -57,6 +61,8 @@ let current = null;            // selected graph
 let graphList = [], opNames = [];  // index -> name (XSS-safe handlers)
 const hist = {};               // graph -> [throughput samples]
 const lhist = {};              // graph -> [p99 e2e latency samples]
+const rmark = {};              // graph -> [bool: rescale at this sample]
+const rseen = {};              // graph -> last Rescale_events count
 const open = new Set();        // operator names with replica drill-down
 function fmt(n){ return (n===undefined||n===null)?"":
   Number(n).toLocaleString("en-US",{maximumFractionDigits:1}); }
@@ -132,17 +138,43 @@ function render(snap){
   spark(hist[current]);
   (lhist[current] = lhist[current]||[]).push(worstP99);
   if (lhist[current].length > 120) lhist[current].shift();
-  sparkLine("sparklat", lhist[current], "#b0452b", "µs");
+  // rescale-event markers: a tick on the p99 sparkline wherever the
+  // graph's Rescale_events counter advanced between polls, so a scaling
+  // action is visible right where its latency effect shows up
+  const rs = (st.Rescales||{});
+  const ev = rs.Rescale_events|0;
+  (rmark[current] = rmark[current]||[]).push(
+    ev > (rseen[current]|0));
+  rseen[current] = ev;
+  if (rmark[current].length > 120) rmark[current].shift();
+  const rbadge = ev ? `<span class=badge>rescales ${ev}`+
+    (rs.Rescale_last_op ? ` (last: ${esc(rs.Rescale_last_op)} → `+
+     `${rs.Rescale_last_to|0}, pause `+
+     `${fmt((rs.Rescale_last_pause_s||0)*1e3)}ms)` : "")+`</span>` : "";
+  if (rbadge) el("badges").innerHTML += rbadge;
+  sparkLine("sparklat", lhist[current], "#b0452b", "µs", rmark[current]);
   const svg = (snap.svgs||{})[current];  // server-sanitized
   el("diagram").innerHTML = "<summary>dataflow graph</summary>"+
     (svg || "<pre>"+esc(snap.diagrams[current]||"")+"</pre>");
 }
 function spark(h){ sparkLine("spark", h, "#2b6cb0", " t/s"); }
-function sparkLine(id, h, color, unit){
+function sparkLine(id, h, color, unit, marks){
   const c = el(id), ctx = c.getContext("2d");
   ctx.clearRect(0,0,c.width,c.height);
   if (!h.length) return;
   const max = Math.max(...h, 1);
+  if (marks){  // vertical ticks: one per rescale event in the window
+    ctx.strokeStyle = "#7a5cb0"; ctx.lineWidth = 1;
+    marks.forEach((m,i)=>{
+      if (!m) return;
+      const x = i*(c.width/120);
+      ctx.beginPath(); ctx.setLineDash([3,3]);
+      ctx.moveTo(x, 2); ctx.lineTo(x, c.height-2); ctx.stroke();
+      ctx.setLineDash([]);
+      ctx.fillStyle = "#7a5cb0"; ctx.font = "9px monospace";
+      ctx.fillText("⇅", Math.min(x+2, c.width-10), c.height-4);
+    });
+  }
   ctx.beginPath(); ctx.strokeStyle = color; ctx.lineWidth = 1.6;
   h.forEach((v,i)=>{
     const x = i*(c.width/120), y = c.height-4-(v/max)*(c.height-12);

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, List, Optional
 
+from ..basic import RescaleTeardown
 from ..message import EOS, Barrier
 from .channel import Channel
 from .collectors import BarrierAligner
@@ -95,6 +96,12 @@ class Worker(threading.Thread):
             self._process()
             self._retire()
             self._shutdown()
+        except RescaleTeardown:
+            # elastic rescale (windflow_tpu.scaling): the controller is
+            # rebuilding the runtime plane from the checkpoint we just
+            # acked — exit silently, no EOS cascade, no retirement (our
+            # channels and emitters are about to be discarded)
+            return
         except BaseException as e:
             self.error = e
             # crash visibility FIRST (while the ring still holds the
@@ -286,6 +293,21 @@ class Worker(threading.Thread):
             self.flightrec.event("ckpt_ack", 0.0,
                                  {"ckpt_id": barrier.ckpt_id,
                                   "bytes": nbytes})
+        # rescale quiesce point (windflow_tpu.scaling): a held epoch
+        # parks every worker right here — after the ack, with all
+        # pre-barrier output flushed and the barrier forwarded, before
+        # any post-barrier tuple is produced
+        t_park = time.perf_counter()
+        directive = coord.park_if_held(barrier.ckpt_id, self.name)
+        if directive is not None:
+            if self.flightrec is not None:
+                self.flightrec.event(
+                    "rescale:parked",
+                    (time.perf_counter() - t_park) * 1e6,
+                    {"ckpt_id": barrier.ckpt_id,
+                     "directive": directive})
+            if directive == "abandon":
+                raise RescaleTeardown()
 
     def _capture_blobs(self) -> dict:
         blobs = {}

@@ -1,0 +1,24 @@
+"""Elastic rescaling: checkpoint-driven live repartitioning of keyed
+state plus the autoscaler control loop that closes observe -> decide ->
+act over the observability plane.
+
+- ``repartition``: StateRepartitioner — split/merge per-replica keyed
+  checkpoint blobs N -> M by the KEYBY routing function;
+- ``controller``: RescaleController — quiesce at an aligned barrier,
+  rebuild the runtime plane at the new parallelism, restore the
+  repartitioned blobs, resume (no source-zero replay);
+- ``autoscaler``: AutoscalePolicy / Autoscaler — scale the bottleneck
+  operator up and idle operators down under hysteresis + cooldown.
+
+Entry points live on ``PipeGraph``: ``rescale(op, parallelism)`` and
+``with_autoscaler(policy)`` (env twin ``WF_AUTOSCALE=1``).
+"""
+
+from .autoscaler import Autoscaler, AutoscalePolicy
+from .controller import RescaleController, RescaleReport
+from .repartition import (repartition_refusal, split_collector_states,
+                          split_operator_states)
+
+__all__ = ["Autoscaler", "AutoscalePolicy", "RescaleController",
+           "RescaleReport", "repartition_refusal",
+           "split_operator_states", "split_collector_states"]

@@ -1,0 +1,257 @@
+"""Autoscaler: the observe -> decide -> act policy loop.
+
+Consumes exactly the signals the observability plane already exports per
+replica (PR 2/PR 5) and acts through ``RescaleController``:
+
+- ``Queue_blocked_put_usec``: producer time blocked on an operator's full
+  input queue — the operator IS the bottleneck (backpressure);
+- ``Worker_idle_ticks`` + ``Queue_blocked_get_usec``: the operator is
+  starved — a scale-down candidate;
+- sink-side ``Latency_e2e_p99_usec``: the user-facing symptom that
+  confirms a scale-up (p99 degrading while something backpressures).
+
+Decisions are rate-based deltas between 1 Hz-ish snapshots, debounced by
+HYSTERESIS consecutive windows, and separated by a COOLDOWN after every
+action (a rescale resets counters and perturbs the pipeline; deciding
+again off that transient would oscillate). Scale-up multiplies
+parallelism by FACTOR (bounded by MAX_PAR) — a surge needs a step
+response; scale-down retreats one replica at a time — draining capacity
+is the risky direction.
+
+Every decision (acted or vetoed) is recorded: ``Autoscaler_*`` stats,
+``windflow_rescale_*`` /metrics families, and ``rescale:decision`` spans
+in the flight-recorder timeline.
+
+Env knobs (builder twin: ``PipeGraph.with_autoscaler(policy)``)::
+
+    WF_AUTOSCALE=1              enable with defaults at start()
+    WF_AUTOSCALE_INTERVAL=1.0   snapshot period, seconds
+    WF_AUTOSCALE_COOLDOWN=5.0   seconds after an action before deciding
+    WF_AUTOSCALE_MAX_PAR=8      upper parallelism bound
+    WF_AUTOSCALE_MIN_PAR=1      lower parallelism bound
+    WF_AUTOSCALE_UP_MS=50       blocked-put ms per wall second to scale up
+    WF_AUTOSCALE_DOWN_MS=900    blocked-get ms/s per replica to scale down
+    WF_AUTOSCALE_HYSTERESIS=3   consecutive windows before acting
+    WF_AUTOSCALE_FACTOR=2.0     scale-up multiplier
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default  # malformed knob must not take down the graph
+
+
+class AutoscalePolicy:
+    """Pure decision logic over per-operator signal windows; unit-testable
+    without a running graph (feed ``observe`` synthetic rate dicts)."""
+
+    def __init__(self,
+                 interval_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 min_parallelism: Optional[int] = None,
+                 max_parallelism: Optional[int] = None,
+                 up_blocked_put_ms: Optional[float] = None,
+                 down_blocked_get_ms: Optional[float] = None,
+                 hysteresis: Optional[int] = None,
+                 factor: Optional[float] = None) -> None:
+        self.interval_s = interval_s if interval_s is not None \
+            else _env_f("WF_AUTOSCALE_INTERVAL", 1.0)
+        self.cooldown_s = cooldown_s if cooldown_s is not None \
+            else _env_f("WF_AUTOSCALE_COOLDOWN", 5.0)
+        self.min_parallelism = int(min_parallelism
+                                   if min_parallelism is not None
+                                   else _env_f("WF_AUTOSCALE_MIN_PAR", 1))
+        self.max_parallelism = int(max_parallelism
+                                   if max_parallelism is not None
+                                   else _env_f("WF_AUTOSCALE_MAX_PAR", 8))
+        self.up_blocked_put_ms = up_blocked_put_ms \
+            if up_blocked_put_ms is not None \
+            else _env_f("WF_AUTOSCALE_UP_MS", 50.0)
+        self.down_blocked_get_ms = down_blocked_get_ms \
+            if down_blocked_get_ms is not None \
+            else _env_f("WF_AUTOSCALE_DOWN_MS", 900.0)
+        self.hysteresis = int(hysteresis if hysteresis is not None
+                              else _env_f("WF_AUTOSCALE_HYSTERESIS", 3))
+        self.factor = factor if factor is not None \
+            else _env_f("WF_AUTOSCALE_FACTOR", 2.0)
+        self._up_streak: Dict[str, int] = {}
+        self._down_streak: Dict[str, int] = {}
+        self._last_action_t = 0.0
+
+    def note_action(self, now: float) -> None:
+        self._last_action_t = now
+        self._up_streak.clear()
+        self._down_streak.clear()
+
+    def observe(self, rates: Dict[str, Dict[str, float]], now: float
+                ) -> Optional[Tuple[str, int, str]]:
+        """One decision step. ``rates`` maps eligible operator name ->
+        ``{"parallelism", "blocked_put_ms_per_s", "blocked_get_ms_per_s",
+        "tuples_per_s"}`` (rates already normalized per wall second).
+        Returns ``(op, new_parallelism, reason)`` or None."""
+        if now - self._last_action_t < self.cooldown_s:
+            return None
+        # scale UP the worst backpressured operator first: congestion
+        # upstream masks everything downstream of it
+        worst, worst_rate = None, 0.0
+        for name, m in rates.items():
+            r = m.get("blocked_put_ms_per_s", 0.0)
+            if r >= self.up_blocked_put_ms:
+                self._up_streak[name] = self._up_streak.get(name, 0) + 1
+                if r > worst_rate:
+                    worst, worst_rate = name, r
+            else:
+                self._up_streak[name] = 0
+        if worst is not None \
+                and self._up_streak[worst] >= self.hysteresis:
+            par = int(rates[worst]["parallelism"])
+            new = min(self.max_parallelism,
+                      max(par + 1, int(par * self.factor + 0.5)))
+            if new > par:
+                return (worst, new,
+                        f"backpressure {worst_rate:.0f}ms/s blocked-put "
+                        f">= {self.up_blocked_put_ms:.0f}ms/s "
+                        f"for {self._up_streak[worst]} windows")
+        # scale DOWN a starved operator (never while anything is
+        # backpressured — draining capacity under load oscillates)
+        if worst is None:
+            for name, m in sorted(rates.items()):
+                par = int(m["parallelism"])
+                starved = (m.get("blocked_get_ms_per_s", 0.0)
+                           >= self.down_blocked_get_ms * max(1, par - 1)
+                           and m.get("blocked_put_ms_per_s", 0.0) <= 1.0)
+                if starved and par > self.min_parallelism:
+                    self._down_streak[name] = \
+                        self._down_streak.get(name, 0) + 1
+                    if self._down_streak[name] >= self.hysteresis:
+                        return (name, par - 1,
+                                f"idle {m['blocked_get_ms_per_s']:.0f}"
+                                "ms/s blocked-get for "
+                                f"{self._down_streak[name]} windows")
+                else:
+                    self._down_streak[name] = 0
+        return None
+
+
+class Autoscaler(threading.Thread):
+    """Policy thread: snapshots ``graph.get_stats()`` every interval,
+    derives per-operator rates for the RESCALABLE operators, and acts on
+    the policy's decision through ``graph.rescale``."""
+
+    def __init__(self, graph, policy: Optional[AutoscalePolicy] = None
+                 ) -> None:
+        super().__init__(name=f"autoscaler:{graph.name}", daemon=True)
+        self.graph = graph
+        self.policy = policy or AutoscalePolicy()
+        self.decisions: List[Dict[str, Any]] = []  # acted decisions
+        self.errors = 0
+        self.last_error: Optional[str] = None
+        self._stop_evt = threading.Event()
+        self._prev: Optional[Dict[str, Dict[str, float]]] = None
+        self._prev_t = 0.0
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    # -- signal extraction -----------------------------------------------
+    def _eligible_ops(self) -> Dict[str, Any]:
+        from .repartition import repartition_refusal
+        out = {}
+        for s in self.graph._stages:
+            if any(repartition_refusal(op) is not None for op in s.ops):
+                continue
+            out[s.first_op.name] = s
+        return out
+
+    def _totals(self) -> Dict[str, Dict[str, float]]:
+        st = self.graph.get_stats()
+        eligible = self._eligible_ops()
+        out: Dict[str, Dict[str, float]] = {}
+        for op in st.get("Operators", []):
+            name = op.get("name")
+            if name not in eligible:
+                continue
+            reps = op.get("replicas", [])
+            out[name] = {
+                "parallelism": op.get("parallelism", 1),
+                "blocked_put_usec": sum(r.get("Queue_blocked_put_usec", 0)
+                                        for r in reps),
+                "blocked_get_usec": sum(r.get("Queue_blocked_get_usec", 0)
+                                        for r in reps),
+                "inputs": sum(r.get("Inputs_received", 0) for r in reps),
+            }
+        return out
+
+    def _rates(self, cur: Dict[str, Dict[str, float]], now: float
+               ) -> Dict[str, Dict[str, float]]:
+        prev, prev_t = self._prev, self._prev_t
+        self._prev, self._prev_t = cur, now
+        if prev is None or now <= prev_t:
+            return {}
+        dt = now - prev_t
+        rates = {}
+        for name, m in cur.items():
+            p = prev.get(name)
+            if p is None or p["parallelism"] != m["parallelism"]:
+                continue  # fresh op or mid-rescale counter reset: skip
+            rates[name] = {
+                "parallelism": m["parallelism"],
+                "blocked_put_ms_per_s":
+                    max(0.0, m["blocked_put_usec"] - p["blocked_put_usec"])
+                    / dt / 1e3,
+                "blocked_get_ms_per_s":
+                    max(0.0, m["blocked_get_usec"] - p["blocked_get_usec"])
+                    / dt / 1e3 / max(1, int(m["parallelism"])),
+                "tuples_per_s":
+                    max(0.0, m["inputs"] - p["inputs"]) / dt,
+            }
+        return rates
+
+    # -- loop --------------------------------------------------------------
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.policy.interval_s):
+            try:
+                self._tick()
+            except Exception as e:  # a bad tick must not kill the loop
+                self.errors += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+
+    def _tick(self) -> None:
+        g = self.graph
+        if g._ended:
+            return
+        now = time.monotonic()
+        rates = self._rates(self._totals(), now)
+        decision = self.policy.observe(rates, now)
+        if decision is None:
+            return
+        op, new_par, reason = decision
+        ctrl = g._rescale_controller()
+        ctrl._span("rescale:decision", 0.0,
+                   {"op": op, "to": new_par, "reason": reason})
+        report = g.rescale(op, new_par)
+        self.policy.note_action(time.monotonic())
+        self.decisions.append({
+            "t_unix": time.time(), "op": op,
+            "from": report.get("old_parallelism"), "to": new_par,
+            "reason": reason, "pause_s": report.get("pause_s"),
+        })
+        del self.decisions[:-64]
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "Autoscaler_decisions": len(self.decisions),
+            "Autoscaler_errors": self.errors,
+            "Autoscaler_last_error": self.last_error,
+            "Autoscaler_history": list(self.decisions),
+        }

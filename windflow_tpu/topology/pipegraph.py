@@ -65,6 +65,17 @@ class PipeGraph:
         self._ckpt_interval: Optional[float] = None
         self._ckpt_dir: Optional[str] = None
         self._ckpt_retain = 3
+        # elastic rescaling (windflow_tpu.scaling): live repartitioning
+        # via rescale(); with_autoscaler()/WF_AUTOSCALE=1 close the loop
+        self._rescale_ctrl = None
+        self._autoscale_policy = None
+        self._autoscale_enabled = False
+        self._autoscaler = None
+        self._rescaling = False  # stall watchdog stands down mid-rescale
+        # mark-final-then-drop series retirement: replicas removed by a
+        # scale-down surface ONCE more (Final=true) in get_stats, then
+        # vanish — Prometheus sees a clean series end, not a frozen value
+        self._final_series: List[Dict[str, Any]] = []
         env_iv = os.environ.get("WF_CKPT_INTERVAL")
         if env_iv:
             try:
@@ -99,6 +110,113 @@ class PipeGraph:
             self._ckpt_dir = store_dir
         self._ckpt_retain = retain
         return self
+
+    # ------------------------------------------------------------------
+    # elastic rescaling (windflow_tpu.scaling)
+    # ------------------------------------------------------------------
+    def with_autoscaler(self, policy: Optional[Any] = None) -> "PipeGraph":
+        """Attach the autoscaler control loop: a policy thread watches
+        the per-operator backpressure/starvation gauges and e2e latency
+        and rescales the bottleneck operator up (idle operators down)
+        under hysteresis and cooldown. ``policy`` is an
+        ``AutoscalePolicy`` (None = defaults, tunable via the
+        ``WF_AUTOSCALE_*`` env knobs). Requires checkpointing — enabled
+        implicitly here when not already configured. Env twin:
+        ``WF_AUTOSCALE=1``."""
+        if self._started:
+            raise WindFlowError("with_autoscaler after start()")
+        self._autoscale_enabled = True
+        self._autoscale_policy = policy
+        if not self._ckpt_enabled:
+            self.with_checkpointing()
+        return self
+
+    def _rescale_controller(self):
+        if self._rescale_ctrl is None:
+            from ..scaling.controller import RescaleController
+            self._rescale_ctrl = RescaleController(self)
+        return self._rescale_ctrl
+
+    def rescale(self, op_name: str, parallelism: int,
+                timeout_s: Optional[float] = None) -> Any:
+        """LIVE rescale of one operator (its whole chained stage) to a
+        new parallelism: trigger an aligned checkpoint, quiesce at the
+        barrier, rebuild the stage's replica list and every affected
+        routing table, restore the repartitioned keyed blobs, resume —
+        without replaying from source-zero. Returns a ``RescaleReport``
+        with the measured ``checkpoint_s`` / ``pause_s`` / ``total_s``.
+        Raises ``WindFlowError`` for non-repartitionable operators
+        (global reduce, BROADCAST windows, DP join, persistent sqlite
+        state, sources) and on quiesce timeout (``WF_CKPT_TIMEOUT``)."""
+        self._rescaling = True
+        try:
+            return self._rescale_controller().rescale(op_name, parallelism,
+                                                      timeout_s)
+        finally:
+            self._rescaling = False
+
+    def _note_retired_replicas(self, stage, new_n: int) -> None:
+        """Capture the final stats of replicas a scale-down removes
+        (mark-final-then-drop: exported once more, then gone)."""
+        for op in stage.ops:
+            if getattr(op, "_fused_hidden", False):
+                continue
+            label = getattr(op, "_fused_stage_label", None) or op.name
+            finals = []
+            for r in op.replicas[new_n:]:
+                d = r.stats.to_dict()
+                d["Final"] = True
+                finals.append(d)
+            if finals:
+                self._final_series.append({
+                    "name": label, "kind": type(op).__name__,
+                    "parallelism": 0, "retired": True,
+                    "replicas": finals})
+
+    def _rebuild_runtime(self) -> None:
+        """Discard the runtime plane (replicas, channels, collectors,
+        workers) and rebuild it from the — possibly re-parallelized —
+        stage IR. Callers (the rescale controller) own quiescing: every
+        old worker must already be parked or joined. Flight-recorder
+        rings of old workers stay registered so the Perfetto timeline
+        shows the rescale seam in one trace."""
+        for s in self._stages:
+            s.channels = []
+            s.workers = []
+            for op in s.ops:
+                op.replicas = []
+        self._workers = []
+        self._built = False
+        self._build()
+
+    def _stage_flightrec_events_max(self) -> int:
+        """Largest flight-ring capacity any stage runs with (the rescale
+        controller sizes its own ring to match; 0 = recording off)."""
+        return max((self._stage_flightrec_events(s) for s in self._stages),
+                   default=0)
+
+    def _worker_diagnostics(self, names: List[str]) -> str:
+        """Per-worker evidence for checkpoint-timeout errors: crash
+        tracebacks (``Worker_last_error``) and stall-watchdog flags for
+        the named workers, when available."""
+        parts = []
+        stalled = set(getattr(self._watchdog, "fired", []) or [])
+        for w in self._workers:
+            if w.name not in names:
+                continue
+            if w.error is not None:
+                parts.append(f"{w.name} died: {type(w.error).__name__}: "
+                             f"{w.error}")
+                continue
+            stats = w._stats()
+            last = getattr(stats, "worker_last_error", None) if stats \
+                else None
+            if last:
+                parts.append(f"{w.name} last error: "
+                             f"{last.strip().splitlines()[-1]}")
+            if w.name in stalled:
+                parts.append(f"{w.name} flagged by the stall watchdog")
+        return "; ".join(parts)
 
     # ------------------------------------------------------------------
     # flight recorder (monitoring/flightrec.py)
@@ -192,13 +310,21 @@ class PipeGraph:
         except Exception:
             pass
 
-    def trigger_checkpoint(self) -> Optional[int]:
+    def trigger_checkpoint(self, wait: bool = False,
+                           timeout_s: Optional[float] = None
+                           ) -> Optional[int]:
         """Force a checkpoint epoch now (sources inject barriers at their
         next tuple boundary). Returns the checkpoint id, or None when
-        checkpointing is not enabled/running."""
+        checkpointing is not enabled/running. With ``wait=True``, blocks
+        until the epoch commits and raises a descriptive
+        ``WindFlowError`` naming the unacked workers if it times out
+        (``timeout_s``, default ``WF_CKPT_TIMEOUT``)."""
         if self._coordinator is None:
             return None
-        return self._coordinator.trigger(force=True)
+        cid = self._coordinator.trigger(force=True)
+        if wait and cid is not None:
+            self._coordinator.wait_committed(cid, timeout_s)
+        return cid
 
     def _ckpt_store_dir(self) -> str:
         if self._ckpt_dir:
@@ -239,10 +365,13 @@ class PipeGraph:
 
     def _restore_replicas(self, ckpt_dir: str, manifest: Dict[str, Any]
                           ) -> None:
+        self._restore_states(
+            self._coordinator.store.load_states(ckpt_dir, manifest))
+
+    def _restore_states(self, states: Dict[Any, Any]) -> None:
         """Push every blob's state into the matching rebuilt replica.
         Topology mismatches fail loudly: silently dropping state would
         trade a crash for wrong answers."""
-        states = self._coordinator.store.load_states(ckpt_dir, manifest)
         by_name = {op.name: op for op in self._ops}
         for (op_name, idx), state in states.items():
             op = by_name.get(op_name)
@@ -261,8 +390,10 @@ class PipeGraph:
             if idx >= len(op.replicas):
                 raise WindFlowError(
                     f"restore: operator {op_name!r} was checkpointed with "
-                    f"parallelism > {len(op.replicas)}; rescaling on "
-                    "restore is not supported yet")
+                    f"parallelism > {len(op.replicas)}; a cross-restart "
+                    "parallelism change needs a LIVE rescale "
+                    "(graph.rescale) — restore_from requires the "
+                    "checkpointed topology")
             replica = op.replicas[idx]
             if state.get("__fused__") is not None \
                     and getattr(replica, "fused_signature", None) is None:
@@ -280,8 +411,18 @@ class PipeGraph:
             if em_state is not None and replica.emitter is not None:
                 replica.emitter.restore_emitter_state(em_state)
             coll = getattr(replica, "_collector", None)
-            if coll_state is not None and coll is not None:
-                coll.restore_state(coll_state)
+            if coll_state is not None:
+                if coll is not None:
+                    coll.restore_state(coll_state)
+                elif any(coll_state.get(k) for k in
+                         ("bufs", "heap", "pending")):
+                    # buffered pre-barrier MESSAGES with nowhere to go
+                    # would silently vanish — refuse instead
+                    raise WindFlowError(
+                        f"restore: {op_name!r} replica {idx} has buffered "
+                        "collector state but the rebuilt stage has no "
+                        "collector (input fan-in changed); cannot restore "
+                        "without losing data")
 
     # ------------------------------------------------------------------
     def _register_op(self, op: BasicOperator) -> None:
@@ -596,6 +737,8 @@ class PipeGraph:
             self._restore_replicas(ckpt_dir, manifest)
         if self._coordinator is not None:
             self._coordinator.expected_acks = len(self._workers)
+            self._coordinator.worker_names = [w.name for w in self._workers]
+            self._coordinator.diagnose = self._worker_diagnostics
             self._coordinator.start()
         self._started = True
         self._t0 = time.monotonic()
@@ -618,15 +761,30 @@ class PipeGraph:
             w.start()
         if self._watchdog is not None:
             self._watchdog.start()
+        # autoscaler policy thread (with_autoscaler / WF_AUTOSCALE=1)
+        if self._autoscale_enabled or env_flag("WF_AUTOSCALE"):
+            from ..scaling.autoscaler import Autoscaler
+            self._autoscaler = Autoscaler(self, self._autoscale_policy)
+            self._autoscaler.start()
 
     def wait_end(self) -> None:
         if not self._started:
             raise WindFlowError("PipeGraph not started")
         if self._ended:
             return
-        for w in self._workers:
-            w.join()
+        while True:
+            # a live rescale REPLACES self._workers mid-run: re-read the
+            # list after every join sweep so we wait on the current plane
+            workers = self._workers
+            for w in workers:
+                w.join()
+            if self._workers is workers:
+                if not self._rescaling:
+                    break
+                time.sleep(0.05)  # mid-rescale: the new plane is coming
         self._ended = True
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         self.elapsed_sec = time.monotonic() - self._t0
         if self._watchdog is not None:
             self._watchdog.stop()
@@ -689,6 +847,10 @@ class PipeGraph:
                 "parallelism": op.parallelism,
                 "replicas": [r.stats.to_dict() for r in op.replicas],
             })
+        # mark-final-then-drop: replicas a scale-down removed appear in
+        # exactly ONE report with Final=true, then their series end
+        finals, self._final_series = self._final_series, []
+        ops.extend(finals)
         st = {
             "PipeGraph_name": self.name,
             "Mode": self.execution_mode.name,
@@ -699,6 +861,10 @@ class PipeGraph:
         }
         if self._coordinator is not None:
             st["Checkpoints"] = self._coordinator.stats()
+        if self._rescale_ctrl is not None:
+            st["Rescales"] = self._rescale_ctrl.stats()
+        if self._autoscaler is not None:
+            st["Autoscaler"] = self._autoscaler.stats()
         # crash visibility: a worker that died no longer disappears
         # silently — its exception surfaces in the final report (the
         # replica-level Worker_last_error carries the full traceback)
