@@ -953,12 +953,161 @@ def _surge_mode() -> None:
     print(json.dumps(result))
 
 
+def _replay_mode() -> None:
+    """Realistic-traffic replay scenario (``bench.py --replay``): a
+    million-user-shaped workload — Zipf-skewed keys, a compressed
+    diurnal rate curve (0.5x -> 1x -> 2x -> 1.5x -> 0.7x), ragged burst
+    sizes and late events (EVENT_TIME with bounded lateness) — through
+    time-based keyed windows into a sink, run once at-least-once and
+    once with the exactly-once sink plane on, checkpointing every ~2 s.
+    Reports throughput for both runs, the measured exactly-once
+    overhead and the commit accounting (epochs pre-committed/committed,
+    commit latency). The runs are wall-clock rate-paced so tuple counts
+    differ slightly; correctness differentials live in
+    tests/test_exactly_once.py. CPU-plane by construction. Writes
+    results/replay.json."""
+    import shutil
+    import tempfile
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph,
+                              Sink_Builder, Source_Builder, TimePolicy,
+                              WinType)
+
+    n_keys = int(os.environ.get("WF_REPLAY_KEYS", "512"))
+    base_rate = float(os.environ.get("WF_REPLAY_RATE", "12000"))
+    phase_s = float(os.environ.get("WF_REPLAY_PHASE_SEC", "2"))
+    late_frac = float(os.environ.get("WF_REPLAY_LATE_FRAC", "0.05"))
+    lateness_us = 200_000
+    rate_curve = (0.5, 1.0, 2.0, 1.5, 0.7)  # compressed diurnal shape
+    rng = np.random.default_rng(11)
+    ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+    probs = 1.0 / ranks ** 1.1
+    probs /= probs.sum()
+    key_table = rng.choice(n_keys, size=1 << 16, p=probs)
+    jitter_table = rng.integers(0, lateness_us, size=1 << 16)
+    late_table = rng.random(1 << 16) < late_frac
+    burst_table = rng.integers(1, 32, size=4096)  # ragged bursts
+
+    class ReplaySource:
+        """Rate-paced Zipf pusher with event-time jitter: most tuples
+        carry now-ish timestamps, a ``late_frac`` slice lags by up to
+        the window lateness bound, watermarks advance behind the
+        lag so late-but-admissible tuples genuinely arrive late."""
+
+        def __init__(self):
+            self.pos = 0
+
+        def __call__(self, shipper):
+            t0 = time.monotonic()
+            i = 0
+            total_s = len(rate_curve) * phase_s
+            while True:
+                t_rel = time.monotonic() - t0
+                if t_rel >= total_s:
+                    return
+                rate = base_rate * rate_curve[
+                    min(int(t_rel / phase_s), len(rate_curve) - 1)]
+                burst = int(burst_table[i & 0xFFF])
+                now_us = int(time.time() * 1e6)
+                for _ in range(burst):
+                    j = i & 0xFFFF
+                    ts = now_us - (int(jitter_table[j])
+                                   if late_table[j] else 0)
+                    shipper.push_with_timestamp(
+                        {"key": int(key_table[j]), "v": i}, ts)
+                    i += 1
+                shipper.set_next_watermark(now_us - lateness_us)
+                self.pos = i
+                time.sleep(max(0.0, burst / rate
+                               - (time.monotonic() - t0 - t_rel)))
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    def run(exactly_once: bool) -> dict:
+        results = {}
+        src = ReplaySource()
+        store = tempfile.mkdtemp(prefix="wf_replay_ckpt_")
+        txn = tempfile.mkdtemp(prefix="wf_replay_txn_")
+        g = PipeGraph(f"replay_{'eo' if exactly_once else 'alo'}",
+                      ExecutionMode.DEFAULT, TimePolicy.EVENT_TIME,
+                      channel_capacity=256)
+        g.with_checkpointing(interval=2.0, store_dir=store)
+        win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                            key_extractor=lambda t: t["key"],
+                            win_len=500_000, slide_len=500_000,
+                            win_type=WinType.TB, lateness=lateness_us,
+                            name="sessions", parallelism=2)
+
+        def sink(t):
+            if t is not None:
+                results[(t.key, t.wid)] = t.value
+
+        snk = Sink_Builder(sink).with_name("snk")
+        if exactly_once:
+            snk = snk.with_exactly_once(staging_dir=txn)
+        g.add_source(Source_Builder(src).with_name("src").build()) \
+            .add(win) \
+            .add_sink(snk.build())
+        t0 = time.perf_counter()
+        g.run()
+        elapsed = time.perf_counter() - t0
+        st = g.get_stats()
+        out = {
+            "tuples": src.pos,
+            "tuples_per_sec": round(src.pos / elapsed, 1),
+            "window_results": len(results),
+            "checkpoints": st.get("Checkpoints", {}).get(
+                "Checkpoints_completed", 0),
+        }
+        if exactly_once:
+            snk_op = [op for op in g._ops if op.name == "snk"][0]
+            rep = snk_op.replicas[0]
+            drv = rep._txn
+            out["txn"] = {
+                "precommits": rep.stats.txn_precommits,
+                "commits": rep.stats.txn_commits,
+                "commit_latency_mean_us": round(
+                    drv.commit_latency_total_us / max(1, drv.commits), 1),
+            }
+        shutil.rmtree(store, ignore_errors=True)
+        shutil.rmtree(txn, ignore_errors=True)
+        return out, results
+
+    print("replay: at-least-once run", file=sys.stderr)
+    alo, alo_res = run(False)
+    print("replay: exactly-once run", file=sys.stderr)
+    eo, eo_res = run(True)
+    overhead = (100.0 * (1.0 - eo["tuples_per_sec"]
+                         / alo["tuples_per_sec"])
+                if alo["tuples_per_sec"] else 0.0)
+    result = {
+        "metric": "replay_realistic_traffic (cpu-plane)",
+        "zipf_keys": n_keys, "base_rate_tps": base_rate,
+        "rate_curve": list(rate_curve), "phase_sec": phase_s,
+        "late_fraction": late_frac, "lateness_usec": lateness_us,
+        "at_least_once": alo, "exactly_once": eo,
+        "exactly_once_overhead_pct": round(overhead, 2),
+    }
+    os.makedirs("results", exist_ok=True)
+    with open(os.path.join("results", "replay.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--ab":
         _ab_mode(sys.argv[2] if len(sys.argv) > 2 else AB_PIN_SHA)
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--surge":
         _surge_mode()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--replay":
+        _replay_mode()
         return
     fallback = os.environ.get("WF_BENCH_FALLBACK") == "1"
     if not fallback and not _probe_backend():

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Chaos harness: randomized crash-injection sweeps over a seeded
+exactly-once pipeline.
+
+Promotes the ad-hoc kill-point machinery of
+``tests/test_checkpoint_recovery.py`` into a reusable harness. Every
+round builds the same seeded pipeline — replayable integer source →
+keyed CB windows (parallelism 2) → exactly-once sink — kills it at a
+randomized point in one of three ways, restores from the surviving
+checkpoint store, and verifies the exactly-once contract:
+
+- ``kill_point``     — crash inside the source at a random tuple, after
+                       a random number of checkpoint epochs committed;
+- ``kill_during_commit`` — crash INSIDE the sink's phase-2 segment
+                       rename (the 2PC window a naive sink gets wrong);
+- ``kill_during_rescale`` — crash in the middle of a live ``rescale()``
+                       after the old runtime plane is torn down (the
+                       worst point: no workers exist).
+
+Verification: the committed segment records and the functor outputs of
+crash-run + restore-run together equal an uninterrupted golden run's —
+zero duplicates, zero loss — and for the rescale scenario the rescale
+checkpoint restores at the original parallelism.
+
+Runnable standalone::
+
+    python scripts/chaos.py --seed 7 --rounds 6 --out results/chaos.json
+
+and as the ``chaos``-marked pytest suite (``tests/test_chaos.py``,
+``pytest -m chaos``; the marker is registered in tests/conftest.py like
+``slow``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale")
+
+
+class InjectedCrash(Exception):
+    pass
+
+
+class ChaosSource:
+    """Replayable seeded source: integers 0..n-1 keyed ``v % nk``;
+    checkpoints at ``ckpt_at`` positions, crash at ``crash_at``, and an
+    optional gate (the rescale scenario pauses mid-stream)."""
+
+    def __init__(self, n, nk, ckpt_at=(), crash_at=None, gate_at=None,
+                 gate=None):
+        self.n, self.nk = n, nk
+        self.ckpt_at = set(ckpt_at)
+        self.crash_at = crash_at
+        self.gate_at, self.gate = gate_at, gate
+        self.pos = 0
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.crash_at is not None and self.pos == self.crash_at:
+                raise InjectedCrash(f"killed at tuple {self.pos}")
+            if self.gate_at is not None and self.pos == self.gate_at:
+                self.gate.wait(30)
+            v = self.pos
+            shipper.push({"k": v % self.nk, "v": v})
+            self.pos += 1
+            if self.pos in self.ckpt_at:
+                shipper.request_checkpoint()
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+def _build(store, src, txn_dir, results, nk):
+    from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph,
+                              Sink_Builder, Source_Builder, TimePolicy,
+                              WinType)
+
+    g = PipeGraph("chaos", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results.append((t.key, t.wid, t.value))
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(win) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=txn_dir).build())
+    return g
+
+
+def _committed_results(txn_dir):
+    from windflow_tpu.sinks.transactional import read_committed_records
+    recs = read_committed_records(os.path.join(txn_dir, "snk_r0"))
+    return sorted((r.key, r.wid, r.value) for r, _ in recs)
+
+
+def _golden(workdir, n, nk):
+    results = []
+    _build(os.path.join(workdir, "gold_store"), ChaosSource(n, nk),
+           os.path.join(workdir, "gold_txn"), results, nk).run()
+    return sorted(results)
+
+
+def _verify(golden, crash_res, rest_res, txn_dir):
+    problems = []
+    merged = sorted(crash_res + rest_res)
+    if merged != golden:
+        lost = len([x for x in golden if x not in set(merged)])
+        extra = len(merged) - len(golden) + lost
+        problems.append(f"functor outputs diverge: {extra} duplicate(s), "
+                        f"{lost} lost (got {len(merged)}, "
+                        f"want {len(golden)})")
+    segs = _committed_results(txn_dir)
+    if segs != golden:
+        problems.append(f"committed segments diverge: got {len(segs)} "
+                        f"records, want {len(golden)}")
+    return problems
+
+
+def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
+              nk: int = 7) -> dict:
+    """One seeded chaos round; returns a report dict with ``ok``."""
+    rng = random.Random((seed << 8) ^ hash(scenario) & 0xFFFF)
+    os.makedirs(workdir, exist_ok=True)
+    golden = _golden(workdir, n, nk)
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    report = {"scenario": scenario, "seed": seed, "n": n, "nk": nk}
+
+    if scenario == "kill_point":
+        n_ckpts = rng.randint(1, 3)
+        ckpt_at = sorted(rng.sample(range(100, n - 200), n_ckpts))
+        crash_at = rng.randrange(ckpt_at[0] + 1, n)
+        report.update(ckpt_at=ckpt_at, crash_at=crash_at)
+        crash_res = []
+        g = _build(store, ChaosSource(n, nk, ckpt_at, crash_at), txn,
+                   crash_res, nk)
+        try:
+            g.run()
+            return {**report, "ok": False,
+                    "problems": ["injected crash never fired"]}
+        except InjectedCrash:
+            pass
+
+    elif scenario == "kill_during_commit":
+        from windflow_tpu.sinks.transactional import EpochSegmentStore
+        ckpt_at = [rng.randrange(200, n - 400)]
+        report.update(ckpt_at=ckpt_at)
+        crash_res = []
+        g = _build(store, ChaosSource(n, nk, ckpt_at), txn, crash_res, nk)
+        orig = EpochSegmentStore.commit
+        armed = [True]
+
+        def dying(self, epoch):
+            if armed[0]:
+                armed[0] = False
+                raise InjectedCrash("killed inside segment commit")
+            return orig(self, epoch)
+
+        EpochSegmentStore.commit = dying
+        try:
+            g.run()
+            return {**report, "ok": False,
+                    "problems": ["injected commit crash never fired"]}
+        except InjectedCrash:
+            pass
+        finally:
+            EpochSegmentStore.commit = orig
+
+    elif scenario == "kill_during_rescale":
+        from windflow_tpu.topology.pipegraph import PipeGraph
+        gate = threading.Event()
+        gate_at = rng.randrange(400, n - 400)
+        report.update(gate_at=gate_at)
+        crash_res = []
+        src = ChaosSource(n, nk, gate_at=gate_at, gate=gate)
+        g = _build(store, src, txn, crash_res, nk)
+        g.start()
+        while src.pos < gate_at:
+            time.sleep(0.01)
+        orig = PipeGraph._rebuild_runtime
+        PipeGraph._rebuild_runtime = lambda self: (_ for _ in ()).throw(
+            InjectedCrash("killed mid-rescale"))
+        try:
+            threading.Timer(0.2, gate.set).start()
+            try:
+                g.rescale("kw", 4, timeout_s=30)
+                return {**report, "ok": False,
+                        "problems": ["rescale kill never fired"]}
+            except InjectedCrash:
+                pass
+        finally:
+            PipeGraph._rebuild_runtime = orig
+        if g._coordinator.completed < 1:
+            return {**report, "ok": False,
+                    "problems": ["rescale checkpoint never committed"]}
+    else:
+        raise ValueError(f"unknown scenario {scenario!r} "
+                         f"(choose from {SCENARIOS})")
+
+    report["committed_epochs"] = g._coordinator.completed
+    rest_res = []
+    g2 = _build(store, ChaosSource(n, nk), txn, rest_res, nk)
+    g2.run(restore_from=store)
+    problems = _verify(golden, crash_res, rest_res, txn)
+    report.update(ok=not problems, problems=problems,
+                  results=len(golden))
+    return report
+
+
+def run_sweep(seed: int, rounds: int, scenarios=SCENARIOS,
+              workdir=None, n: int = 2000) -> dict:
+    """``rounds`` rounds cycling through ``scenarios``, each in a fresh
+    work directory; returns the aggregate report."""
+    base = workdir or tempfile.mkdtemp(prefix="wf_chaos_")
+    out = {"seed": seed, "rounds": []}
+    try:
+        for i in range(rounds):
+            scenario = scenarios[i % len(scenarios)]
+            rdir = os.path.join(base, f"round_{i}")
+            rep = run_round(seed + i, scenario, rdir, n=n)
+            out["rounds"].append(rep)
+            print(json.dumps(rep), file=sys.stderr)
+            shutil.rmtree(rdir, ignore_errors=True)
+    finally:
+        if workdir is None:
+            shutil.rmtree(base, ignore_errors=True)
+    out["ok"] = all(r["ok"] for r in out["rounds"])
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--n", type=int, default=2000,
+                    help="tuples per round (default 2000)")
+    ap.add_argument("--scenario", choices=SCENARIOS, default=None,
+                    help="run only this scenario (default: cycle all)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON report here (e.g. "
+                         "results/chaos.json)")
+    args = ap.parse_args()
+    scenarios = (args.scenario,) if args.scenario else SCENARIOS
+    report = run_sweep(args.seed, args.rounds, scenarios, n=args.n)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps({"chaos": "OK" if report["ok"] else "FAIL",
+                      "rounds": len(report["rounds"]),
+                      "failed": [r for r in report["rounds"]
+                                 if not r["ok"]]}))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,11 @@ REQUIRED_FAMILIES = (
     "windflow_rescale_last_pause_seconds",
     "windflow_rescale_last_total_seconds",
     "windflow_checkpoints_completed_total",
+    # exactly-once sink 2PC (the run's sink is transactional)
+    "windflow_sink_txn_precommits_total",
+    "windflow_sink_txn_commits_total",
+    "windflow_sink_txn_aborts_total",
+    "windflow_sink_txn_fenced_writes_total",
 )
 
 _SAMPLE_RE = re.compile(
@@ -192,7 +197,10 @@ def run_graph_and_scrape():
               .with_name("dbl").build()) \
          .add_sink(Sink_Builder(
              lambda t: seen.__setitem__(0, seen[0] + 1) if t else None)
-             .with_name("out").build())
+             .with_name("out")
+             .with_exactly_once(
+                 staging_dir=tempfile.mkdtemp(prefix="wf_txn_"))
+             .build())
         g.start()
         deadline = _time.monotonic() + 15
         while pos[0] < 10_000 and _time.monotonic() < deadline:

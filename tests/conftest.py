@@ -43,3 +43,7 @@ def pytest_configure(config):
         "markers",
         "slow: long-running matrix tests excluded from tier-1 "
         "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: randomized crash-injection sweeps (scripts/chaos.py); "
+        "run explicitly with -m chaos")

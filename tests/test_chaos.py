@@ -1,0 +1,43 @@
+"""Chaos-marked suite wrapping ``scripts/chaos.py`` (the promoted
+kill-point machinery): randomized kill-point, kill-during-commit and
+kill-during-rescale rounds over the seeded exactly-once pipeline.
+
+Run explicitly with ``pytest -m chaos``; the quick rounds also ride the
+default suite (seeded — fully deterministic), the multi-round sweep is
+additionally ``slow``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import chaos  # noqa: E402  (scripts/chaos.py)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_chaos_kill_point(tmp_path, seed):
+    rep = chaos.run_round(seed, "kill_point", str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+
+
+def test_chaos_kill_during_commit(tmp_path):
+    rep = chaos.run_round(11, "kill_during_commit", str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+
+
+def test_chaos_kill_during_rescale(tmp_path):
+    rep = chaos.run_round(5, "kill_during_rescale", str(tmp_path), n=2400)
+    assert rep["ok"], rep["problems"]
+
+
+@pytest.mark.slow
+def test_chaos_sweep(tmp_path):
+    rep = chaos.run_sweep(31, rounds=6, workdir=str(tmp_path))
+    assert rep["ok"], [r for r in rep["rounds"] if not r["ok"]]

@@ -1,0 +1,653 @@
+"""Exactly-once sinks (windflow_tpu.sinks.transactional): epoch-fenced
+two-phase commit on checkpoint finalize.
+
+The differentials kill a pipeline at every 2PC phase — mid-epoch
+(pre-barrier), after the sink pre-committed but before the coordinator
+finalized, after finalize but before the sink-side phase-2 commit, and
+IN the commit itself — then restore and assert the committed sink output
+equals an uninterrupted golden run's: zero duplicates, zero loss, and
+(for deterministic single-replica chains) byte-identical epoch
+concatenation. Zombie fencing is exercised across a live ``rescale()``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph, Reduce,
+                          Sink_Builder, Source_Builder, TimePolicy,
+                          WindFlowError, WinType)
+from windflow_tpu.checkpoint import CheckpointStore
+from windflow_tpu.kafka.builders_kafka import Kafka_Sink_Builder
+from windflow_tpu.kafka.connectors import MemoryBroker
+from windflow_tpu.persistent.builders_persistent import P_Sink_Builder
+from windflow_tpu.persistent.db_handle import DBHandle
+from windflow_tpu.sinks.transactional import (EpochSegmentStore,
+                                              EpochTxnDriver,
+                                              FencedWriteError,
+                                              read_committed_records)
+
+
+class InjectedCrash(Exception):
+    pass
+
+
+class ReplaySource:
+    """Deterministic replayable source (same protocol as the recovery
+    suite): integers 0..n-1 keyed ``v % nk``; checkpoints requested at
+    ``ckpt_at`` positions; crash injected at ``crash_at``."""
+
+    def __init__(self, n, nk=5, ckpt_at=(), crash_at=None):
+        self.n = n
+        self.nk = nk
+        self.ckpt_at = set(ckpt_at if not isinstance(ckpt_at, int)
+                           else [ckpt_at])
+        self.crash_at = crash_at
+        self.pos = 0
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.crash_at is not None and self.pos == self.crash_at:
+                raise InjectedCrash(f"killed at tuple {self.pos}")
+            v = self.pos
+            shipper.push({"k": v % self.nk, "v": v})
+            self.pos += 1
+            if self.pos in self.ckpt_at:
+                assert shipper.request_checkpoint() is not None
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+# ---------------------------------------------------------------------------
+# row sink: the deterministic forward chain gives byte-identical output
+# ---------------------------------------------------------------------------
+def _row_graph(store, src, txn_dir, results):
+    g = PipeGraph("eo_row", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+
+    def sink(t):
+        if t is not None:
+            results.append(t["v"])
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=txn_dir).build())
+    return g
+
+
+def _row_golden(tmp_path, n=1500):
+    res = []
+    _row_graph(str(tmp_path / "gold_store"), ReplaySource(n),
+               str(tmp_path / "gold_txn"), res).run()
+    return res, read_committed_records(str(tmp_path / "gold_txn" / "snk_r0"))
+
+
+def _row_crash_restore(tmp_path, n=1500, ckpt_at=(500,), crash_at=1000,
+                       pre_crash=None, post_crash=None):
+    """Crash run + restore run over a shared store/txn dir; returns the
+    restored graph and both runs' functor outputs."""
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    crash_res = []
+    g = _row_graph(store, ReplaySource(n, ckpt_at=ckpt_at,
+                                       crash_at=crash_at), txn, crash_res)
+    if pre_crash:
+        pre_crash(g)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    if post_crash:
+        post_crash(g)
+    rest_res = []
+    g2 = _row_graph(store, ReplaySource(n), txn, rest_res)
+    g2.run(restore_from=store)
+    return g2, crash_res, rest_res, txn
+
+
+def test_row_kill_mid_epoch_byte_identical(tmp_path):
+    """Pre-barrier kill: records after the committed barrier were never
+    pre-committed — the replay regenerates them exactly once."""
+    golden, gold_segs = _row_golden(tmp_path)
+    g2, crash_res, rest_res, txn = _row_crash_restore(tmp_path)
+    segs = read_committed_records(str(tmp_path / "txn" / "snk_r0"))
+    assert [p["v"] for p, _ in segs] == [p["v"] for p, _ in gold_segs] == golden
+    # the functor saw every record exactly once across the two runs
+    assert crash_res + rest_res == golden
+
+
+def test_row_kill_post_precommit_pre_finalize(tmp_path, monkeypatch):
+    """The sink pre-commits epoch 2, the crash lands before the
+    coordinator can finalize it (the store commit of epoch 2 dies):
+    restore resolves epoch 1, aborts the staged epoch-2 segment, and the
+    replay regenerates its records."""
+    golden, gold_segs = _row_golden(tmp_path)
+    orig = CheckpointStore.commit
+
+    def dying_commit(self, ckpt_id, manifest):
+        if ckpt_id == 2:
+            raise InjectedCrash("store commit of epoch 2")
+        return orig(self, ckpt_id, manifest)
+
+    monkeypatch.setattr(CheckpointStore, "commit", dying_commit)
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    crash_res = []
+    g = _row_graph(store, ReplaySource(1500, ckpt_at=(400, 900),
+                                       crash_at=1300), txn, crash_res)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    monkeypatch.undo()
+    assert g._coordinator.completed == 1  # epoch 2 never finalized
+    seg_store = EpochSegmentStore(os.path.join(txn, "snk_r0"))
+    assert 2 in seg_store.pending_epochs()  # pre-committed, unfinalized
+    rest_res = []
+    g2 = _row_graph(store, ReplaySource(1500), txn, rest_res)
+    g2.run(restore_from=store)
+    assert seg_store.pending_epochs() == []  # aborted on restore
+    segs = read_committed_records(os.path.join(txn, "snk_r0"))
+    assert [p["v"] for p, _ in segs] == golden
+    assert crash_res + rest_res == golden
+    st = [r for o in g2.get_stats()["Operators"] if o["name"] == "snk"
+          for r in o["replicas"]][0]
+    assert st["Sink_txn_aborts"] >= 1
+
+
+def test_row_kill_post_finalize_rolls_forward(tmp_path, monkeypatch):
+    """The coordinator finalized epoch 2 but the sink never ran its
+    phase-2 rename (poll disabled + crash): restore must roll the
+    pending segment FORWARD — its records are pre-barrier data the
+    replay will not regenerate."""
+    golden, _ = _row_golden(tmp_path)
+    monkeypatch.setattr(EpochTxnDriver, "poll", lambda self: False)
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    crash_res = []
+    g = _row_graph(store, ReplaySource(1500, ckpt_at=(400, 900),
+                                       crash_at=1300), txn, crash_res)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    monkeypatch.undo()
+    assert g._coordinator.completed == 2
+    seg_store = EpochSegmentStore(os.path.join(txn, "snk_r0"))
+    pend = seg_store.pending_epochs()
+    assert 1 in pend and 2 in pend  # finalized but never renamed
+    rest_res = []
+    g2 = _row_graph(store, ReplaySource(1500), txn, rest_res)
+    g2.run(restore_from=store)
+    segs = read_committed_records(os.path.join(txn, "snk_r0"))
+    assert [p["v"] for p, _ in segs] == golden
+    # roll-forward delivered epochs 1+2 to the restored functor; the
+    # crashed run's functor saw nothing (commits never ran there)
+    assert crash_res == []
+    assert rest_res == golden
+
+
+def test_row_kill_during_commit(tmp_path, monkeypatch):
+    """The crash lands INSIDE the sink's phase-2 rename: the pending
+    file survives, restore rolls it forward, nothing duplicates."""
+    golden, _ = _row_golden(tmp_path)
+    orig = EpochSegmentStore.commit
+    state = {"armed": True}
+
+    def dying(self, epoch):
+        if state["armed"]:
+            state["armed"] = False
+            raise InjectedCrash("killed inside commit")
+        return orig(self, epoch)
+
+    monkeypatch.setattr(EpochSegmentStore, "commit", dying)
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    crash_res = []
+    g = _row_graph(store, ReplaySource(1500, ckpt_at=(500,)), txn,
+                   crash_res)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    monkeypatch.undo()
+    rest_res = []
+    g2 = _row_graph(store, ReplaySource(1500), txn, rest_res)
+    g2.run(restore_from=store)
+    segs = read_committed_records(os.path.join(txn, "snk_r0"))
+    assert [p["v"] for p, _ in segs] == golden
+    assert crash_res + rest_res == golden
+
+
+def test_row_restore_from_older_checkpoint_discards_replayed_epochs(
+        tmp_path):
+    """Replaying from a checkpoint OLDER than already-committed epochs:
+    the sink recognizes the committed epoch ids and discards the
+    replayed duplicates instead of re-emitting them."""
+    golden, _ = _row_golden(tmp_path)
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    res = []
+    g = _row_graph(store, ReplaySource(1500, ckpt_at=(400, 900)), txn, res)
+    g.run()
+    assert g._coordinator.completed == 2
+    segs_before = read_committed_records(os.path.join(txn, "snk_r0"))
+    assert [p["v"] for p, _ in segs_before] == golden
+    # restore from epoch 1 explicitly: epoch 2 (records 400..899) and the
+    # tail replay again, but their epochs are already committed
+    ckpt1_dir = CheckpointStore(store).checkpoint_dir(1)
+    res2 = []
+    g2 = _row_graph(store, ReplaySource(1500), txn, res2)
+    g2.run(restore_from=ckpt1_dir)
+    segs_after = read_committed_records(os.path.join(txn, "snk_r0"))
+    assert [p["v"] for p, _ in segs_after] == golden  # no duplicates appended
+    st = [r for o in g2.get_stats()["Operators"] if o["name"] == "snk"
+          for r in o["replicas"]][0]
+    assert st["Sink_txn_aborts"] >= 1  # the discarded replayed epoch(s)
+
+
+# ---------------------------------------------------------------------------
+# keyed-windows pipeline (parallelism 2): multiset equality under kills
+# ---------------------------------------------------------------------------
+def _kw_graph(store, src, txn_dir, results):
+    g = PipeGraph("eo_kw", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results.append((t.key, t.wid, t.value))
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(win) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=txn_dir).build())
+    return g
+
+
+@pytest.mark.parametrize("crash_at", [700, 1201, 1999])
+def test_keyed_windows_exactly_once_no_dup_no_loss(tmp_path, crash_at):
+    golden = []
+    _kw_graph(str(tmp_path / "gs"), ReplaySource(2000),
+              str(tmp_path / "gt"), golden).run()
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    crash_res = []
+    g = _kw_graph(store, ReplaySource(2000, ckpt_at=(600,),
+                                      crash_at=crash_at), txn, crash_res)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    assert g._coordinator.completed == 1
+    rest_res = []
+    g2 = _kw_graph(store, ReplaySource(2000), txn, rest_res)
+    g2.run(restore_from=store)
+    segs = [r for (r, _) in
+            read_committed_records(os.path.join(txn, "snk_r0"))]
+    got = sorted((r.key, r.wid, r.value) for r in segs)
+    assert got == sorted(golden)  # zero duplicates, zero loss
+    assert sorted(crash_res + rest_res) == sorted(golden)
+
+
+# ---------------------------------------------------------------------------
+# Kafka (mock broker): per-epoch broker transactions + producer fencing
+# ---------------------------------------------------------------------------
+def _kafka_graph(store, src, broker):
+    g = PipeGraph("eo_kafka", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add_sink(Kafka_Sink_Builder(lambda t: ("out", t["k"] % 4, t["v"]))
+                  .with_brokers(f"memory://{broker}").with_name("ksnk")
+                  .with_exactly_once().build())
+    return g
+
+
+def _topic_payloads(broker):
+    b = MemoryBroker.get(broker)
+    out = []
+    for p in range(b.n_partitions):
+        out.extend(m.payload for m in b._topic("out")[p])
+    return sorted(out)
+
+
+def test_kafka_exactly_once_commit_rides_finalize(tmp_path):
+    MemoryBroker.reset()
+    _kafka_graph(str(tmp_path / "gs"), ReplaySource(1000), "kgold").run()
+    golden = _topic_payloads("kgold")
+    assert golden == sorted(range(1000))
+    store = str(tmp_path / "store")
+    g = _kafka_graph(store, ReplaySource(1000, ckpt_at=(300,),
+                                         crash_at=700), "klive")
+    with pytest.raises(InjectedCrash):
+        g.run()
+    # at the crash, exactly the finalized epoch is visible: no tail leak
+    assert _topic_payloads("klive") == sorted(range(300))
+    g2 = _kafka_graph(store, ReplaySource(1000), "klive")
+    g2.run(restore_from=store)
+    assert _topic_payloads("klive") == golden  # no dup, no loss
+
+
+def test_kafka_kill_during_commit_rolls_forward(tmp_path, monkeypatch):
+    MemoryBroker.reset()
+    orig = MemoryBroker.txn_commit
+    state = {"armed": True}
+
+    def dying(self, txn_id, gen, epoch):
+        if state["armed"]:
+            state["armed"] = False
+            raise InjectedCrash("killed inside broker txn commit")
+        return orig(self, txn_id, gen, epoch)
+
+    monkeypatch.setattr(MemoryBroker, "txn_commit", dying)
+    store = str(tmp_path / "store")
+    g = _kafka_graph(store, ReplaySource(1000, ckpt_at=(300,)), "kc")
+    with pytest.raises(InjectedCrash):
+        g.run()
+    monkeypatch.undo()
+    assert _topic_payloads("kc") == []  # prepared, never committed
+    g2 = _kafka_graph(store, ReplaySource(1000), "kc")
+    g2.run(restore_from=store)
+    assert _topic_payloads("kc") == sorted(range(1000))
+
+
+def test_kafka_zombie_producer_fenced():
+    MemoryBroker.reset()
+    b = MemoryBroker.get("fence")
+    gen1 = b.txn_init("wf-txn-x")
+    b.txn_prepare("wf-txn-x", gen1, 1, [("out", 0, None, 1)])
+    gen2 = b.txn_init("wf-txn-x")  # a newer replica takes over
+    with pytest.raises(FencedWriteError):
+        b.txn_prepare("wf-txn-x", gen1, 2, [])
+    with pytest.raises(FencedWriteError):
+        b.txn_commit("wf-txn-x", gen1, 1)
+    # the new generation can still commit the prepared epoch
+    assert b.txn_commit("wf-txn-x", gen2, 1) is True
+    assert b.fenced_attempts == 2
+
+
+# ---------------------------------------------------------------------------
+# persistent sink: epoch-fenced sqlite writer
+# ---------------------------------------------------------------------------
+def _psink_graph(store, src, dbdir):
+    g = PipeGraph("eo_psink", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add_sink(P_Sink_Builder(
+            lambda t, s: (s or 0) + (t["v"] if t is not None else 0))
+            .with_key_by(lambda t: t["k"]).with_db_path(dbdir)
+            .with_name("psnk").with_exactly_once().build())
+    return g
+
+
+def _read_psink_db(dbdir):
+    h = DBHandle("psnk_r0", db_dir=dbdir)
+    data = dict(h.items())
+    meta = {k: h.meta_get(k) for k in ("epoch", "finalized", "fence")}
+    h.close()
+    return data, meta
+
+
+def test_psink_exactly_once_epoch_consistent(tmp_path):
+    golden_db = str(tmp_path / "gdb")
+    _psink_graph(str(tmp_path / "gs"), ReplaySource(1000), golden_db).run()
+    golden, gmeta = _read_psink_db(golden_db)
+    assert golden and gmeta["finalized"] == gmeta["epoch"]
+    store = str(tmp_path / "store")
+    dbdir = str(tmp_path / "db")
+    g = _psink_graph(store, ReplaySource(1000, ckpt_at=(400,),
+                                         crash_at=800), dbdir)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    # mid-crash: epoch 1 (records 0..399) finalized; the emergency-EOS
+    # tail was PRE-committed as epoch 2 — the marker pair flags the DB
+    # as carrying prepared-but-unfinalized state instead of silently
+    # presenting it as final (the external reader's fence)
+    mid, mmeta = _read_psink_db(dbdir)
+    assert mmeta["finalized"] == 1
+    assert mmeta["epoch"] == 2
+    assert mmeta["epoch"] > mmeta["finalized"]
+    g2 = _psink_graph(store, ReplaySource(1000), dbdir)
+    g2.run(restore_from=store)
+    final, fmeta = _read_psink_db(dbdir)
+    assert final == golden
+    assert fmeta["finalized"] == fmeta["epoch"]
+    assert fmeta["fence"] == 2  # crash replica gen 1, restored gen 2
+
+
+def test_psink_zombie_replica_fenced(tmp_path):
+    from windflow_tpu.persistent.p_basic_ops import P_Sink
+
+    dbdir = str(tmp_path / "db")
+    op = P_Sink(lambda t, s: (s or 0) + 1, key_extractor=lambda t: t,
+                initial_state=None, name="zp", parallelism=1,
+                output_batch_size=0, db_dir=dbdir)
+    op.exactly_once = True
+    op.build_replicas()
+    old = op.replicas[0]
+    op.replicas = []
+    op.build_replicas()  # the rebuild bumps the in-DB fence
+    new = op.replicas[0]
+    assert new._fence == old._fence + 1
+    with pytest.raises(FencedWriteError):
+        old.precommit_epoch(1)
+    assert old.stats.txn_fenced_writes == 1
+    # the new generation still commits normally
+    new.precommit_epoch(1)
+    assert new.stats.txn_precommits == 1
+
+
+# ---------------------------------------------------------------------------
+# zombie fencing across a LIVE rescale
+# ---------------------------------------------------------------------------
+def test_fencing_across_rescale(tmp_path):
+    """Rescaling a mid-graph operator rebuilds the whole runtime plane;
+    the pre-rescale sink replica becomes a zombie whose writes the
+    transaction log refuses."""
+    import threading
+    import time
+
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    results = []
+    gate = threading.Event()
+
+    class GatedSource(ReplaySource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.pos == 1000:
+                    gate.wait(20)
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": v})
+                self.pos += 1
+
+    src = GatedSource(3000, nk=7)
+    g = PipeGraph("eo_rescale", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    red = Reduce(lambda t, s: (s or 0) + t["v"],
+                 key_extractor=lambda t: t["k"], name="red", parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results.append(t)
+
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(red) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=txn).build())
+    g.start()
+    while src.pos < 1000:
+        time.sleep(0.01)
+    old_sink = [op for op in g._ops if op.name == "snk"][0].replicas[0]
+    threading.Timer(0.2, gate.set).start()
+    rep = g.rescale("red", 3, timeout_s=30)
+    assert rep.changed
+    g.wait_end()
+    # the zombie's backend generation is stale: fenced, loudly
+    with pytest.raises(FencedWriteError):
+        old_sink._txn.backend.do_precommit(999, [])
+    # rescaling the exactly-once sink ITSELF refuses loudly
+    g2 = PipeGraph("eo_rescale2", ExecutionMode.DEFAULT,
+                   TimePolicy.INGRESS_TIME)
+    g2.with_checkpointing(store_dir=str(tmp_path / "s2"))
+    src2 = ReplaySource(100000, nk=7)
+    g2.add_source(Source_Builder(src2).with_name("src").build()) \
+        .add_sink(Sink_Builder(lambda t: None).with_name("snk")
+                  .with_exactly_once(staging_dir=str(tmp_path / "t2"))
+                  .build())
+    g2.start()
+    try:
+        with pytest.raises(WindFlowError, match="exactly-once"):
+            g2.rescale("snk", 2, timeout_s=10)
+    finally:
+        src2.n = 0  # let the source finish
+        g2.wait_end()
+
+
+# ---------------------------------------------------------------------------
+# guarantee negotiation / refusals
+# ---------------------------------------------------------------------------
+def test_exactly_once_without_checkpointing_refused(tmp_path):
+    g = PipeGraph("eo_neg", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(ReplaySource(10)).with_name("src").build()) \
+        .add_sink(Sink_Builder(lambda t: None).with_name("snk")
+                  .with_exactly_once(staging_dir=str(tmp_path / "t"))
+                  .build())
+    with pytest.raises(WindFlowError, match="checkpoint"):
+        g.run()
+
+
+def test_graph_wide_exactly_once_flips_all_sinks(tmp_path):
+    res = []
+    src = ReplaySource(200, ckpt_at=(100,))
+    g = PipeGraph("eo_graphwide", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "s"))
+    g.with_exactly_once()
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add_sink(Sink_Builder(lambda t: res.append(t["v"])
+                               if t is not None else None)
+                  .with_name("snk").build())
+    os.environ["WF_TXN_DIR"] = str(tmp_path / "txn")
+    try:
+        g.run()
+    finally:
+        del os.environ["WF_TXN_DIR"]
+    assert res == list(range(200))
+    segs = read_committed_records(str(tmp_path / "txn" / "snk_r0"))
+    assert [p["v"] for p, _ in segs] == list(range(200))
+
+
+def test_graph_wide_exactly_once_refuses_incapable_sink(tmp_path):
+    from windflow_tpu.operators.basic_ops import Sink
+
+    class LegacySink(Sink):
+        supports_exactly_once = False
+
+    g = PipeGraph("eo_refuse", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "s"))
+    g.with_exactly_once()
+    g.add_source(Source_Builder(ReplaySource(10)).with_name("src").build()) \
+        .add_sink(LegacySink(lambda t: None, name="legacy"))
+    with pytest.raises(WindFlowError, match="legacy"):
+        g.run()
+
+
+def test_restore_txn_checkpoint_into_plain_sink_refused(tmp_path):
+    store = str(tmp_path / "store")
+    txn = str(tmp_path / "txn")
+    res = []
+    g = _row_graph(store, ReplaySource(500, ckpt_at=(200,)), txn, res)
+    g.run()
+    # same topology WITHOUT exactly-once: the staged-epoch state in the
+    # blob has nowhere to go — refuse instead of silently downgrading
+    g2 = PipeGraph("eo_row", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g2.with_checkpointing(store_dir=store)
+    g2.add_source(Source_Builder(ReplaySource(500)).with_name("src")
+                  .build()) \
+        .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+    with pytest.raises(WindFlowError, match="exactly-once"):
+        g2.run(restore_from=store)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the Kafka sink flushes (loudly) before its ack can finalize
+# ---------------------------------------------------------------------------
+def test_kafka_sink_delivery_error_fails_epoch(tmp_path, monkeypatch):
+    """A lost in-flight produce must fail the checkpoint, not let the
+    coordinator finalize an epoch whose data never reached the broker."""
+    from windflow_tpu.kafka.connectors import MemoryTransport
+
+    MemoryBroker.reset()
+
+    def failing_flush(self):
+        raise WindFlowError("3 delivery error(s)")
+
+    monkeypatch.setattr(MemoryTransport, "flush", failing_flush)
+    g = PipeGraph("kflush", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "s"))
+    g.add_source(Source_Builder(ReplaySource(500, ckpt_at=(200,)))
+                 .with_name("src").build()) \
+        .add_sink(Kafka_Sink_Builder(lambda t: ("out", None, t["v"]))
+                  .with_brokers("memory://kflush").with_name("ksnk")
+                  .build())
+    with pytest.raises(WindFlowError, match="delivery"):
+        g.run()
+    # the epoch never finalized: the sink died before acking it
+    assert g._coordinator.completed == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: retain-K prune never deletes a checkpoint mid-restore-read
+# ---------------------------------------------------------------------------
+def test_prune_waits_for_concurrent_restore_read(tmp_path):
+    import threading
+    import time
+
+    store = CheckpointStore(str(tmp_path), retain=1)
+    store.begin(1)
+    for i in range(4):
+        store.write_blob(1, "op", i, {"cid": 1, "i": i})
+    store.commit(1, {"graph": "t"})
+    d1 = store.checkpoint_dir(1)
+    manifest = store.load_manifest(d1)
+
+    # a reader whose blob loads are slow (mid-restore): prune from a
+    # concurrent committer must NOT delete ckpt 1 under it
+    orig_load = CheckpointStore.load_blob
+    started = threading.Event()
+
+    def slow_load(ckpt_dir, fname):
+        started.set()
+        time.sleep(0.15)
+        return orig_load(ckpt_dir, fname)
+
+    CheckpointStore.load_blob = staticmethod(slow_load)
+    result = {}
+
+    def reader():
+        try:
+            result["states"] = store.load_states(d1, manifest)
+        except BaseException as e:  # pragma: no cover
+            result["error"] = e
+
+    t = threading.Thread(target=reader)
+    try:
+        t.start()
+        started.wait(5)
+        # concurrent commits of newer checkpoints prune (retain=1): with
+        # the store lock they must block until the read completes
+        writer = CheckpointStore(str(tmp_path), retain=1)
+        for cid in (2, 3):
+            writer.begin(cid)
+            writer.write_blob(cid, "op", 0, {"cid": cid})
+            writer.commit(cid, {"graph": "t"})
+        t.join(10)
+    finally:
+        CheckpointStore.load_blob = staticmethod(orig_load)
+    assert "error" not in result, result.get("error")
+    assert len(result["states"]) == 4
+    assert all(st["cid"] == 1 for st in result["states"].values())
+    # retention applied after the read finished
+    assert store.completed_ids() == [3]

@@ -36,6 +36,7 @@ from .operators.windows import (Keyed_Windows, MapReduce_Windows,
                                 Paned_Windows, Parallel_Windows)
 from .operators.source import Source, SourceShipper
 from .scaling.autoscaler import AutoscalePolicy
+from .sinks.transactional import FencedWriteError
 from .topology.multipipe import MultiPipe
 from .topology.pipegraph import PipeGraph
 
@@ -43,7 +44,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ExecutionMode", "TimePolicy", "WinType", "RoutingMode", "JoinMode",
-    "WindFlowError",
+    "WindFlowError", "FencedWriteError",
     "PipeGraph", "MultiPipe",
     "Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
     "SourceShipper", "Shipper",

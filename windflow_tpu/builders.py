@@ -183,6 +183,24 @@ class Sink_Builder(_RoutableBuilder):
     def __init__(self, func: Callable) -> None:
         super().__init__(func)
         self._columns = False
+        self._exactly_once = False
+        self._txn_dir: Optional[str] = None
+
+    def with_exactly_once(self, staging_dir: Optional[str] = None
+                          ) -> "Sink_Builder":
+        """Exactly-once delivery (windflow_tpu.sinks.transactional):
+        output buffers per checkpoint epoch, pre-commits at the aligned
+        barrier as a staged segment file under ``staging_dir`` (default
+        ``$WF_TXN_DIR`` / ``wf_txn_sinks``) and becomes visible —
+        one atomic rename, then the functor call — only when the
+        coordinator finalizes the epoch. Requires
+        ``PipeGraph.with_checkpointing``; the graph refuses loudly
+        otherwise. Env twin for the whole graph: ``WF_EXACTLY_ONCE=1`` /
+        ``PipeGraph.with_exactly_once()``."""
+        self._exactly_once = True
+        if staging_dir is not None:
+            self._txn_dir = staging_dir
+        return self
 
     def with_columns(self) -> "Sink_Builder":
         """Columnar consumer (the exit-side dual of ``push_columns``):
@@ -196,9 +214,12 @@ class Sink_Builder(_RoutableBuilder):
         return self
 
     def build(self) -> Sink:
-        return self._finish(Sink(self._func, self._name, self._parallelism,
-                                 self._routing, self._key_extractor,
-                                 accepts_columns=self._columns))
+        op = self._finish(Sink(self._func, self._name, self._parallelism,
+                               self._routing, self._key_extractor,
+                               accepts_columns=self._columns))
+        op.exactly_once = self._exactly_once
+        op.txn_dir = self._txn_dir
+        return op
 
 
 # ---------------------------------------------------------------------------

@@ -73,6 +73,12 @@ class CheckpointCoordinator:
         # checkpoints of a still-running graph)
         self._retired: Dict[str, Dict[Any, Any]] = {}
         self._listeners: List[Callable[[int], None]] = []
+        # abort listeners (exactly-once sinks): notified with the epoch
+        # id when a pending epoch is failed (WF_CKPT_TIMEOUT) or dropped
+        # wholesale (rescale teardown) — the epoch will never finalize,
+        # so a transactional sink knows its staged records ride the next
+        # committed epoch's watermark instead
+        self._abort_listeners: List[Callable[[int], None]] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # aggregate stats (PipeGraph.get_stats / the /metrics plane)
@@ -301,6 +307,8 @@ class CheckpointCoordinator:
                      if now - ent["t0"] >= t]
             for cid, age in stale:
                 self._fail_epoch_locked(cid, age)
+        for cid, _ in stale:
+            self._notify_aborted(cid)
 
     def wait_committed(self, cid: int, timeout_s: Optional[float] = None
                        ) -> None:
@@ -312,6 +320,7 @@ class CheckpointCoordinator:
         t = timeout_s if timeout_s is not None else self.epoch_timeout_s
         deadline = time.monotonic() + t if t and t > 0 else None
         while True:
+            timed_out_msg = None
             with self._lock:
                 if self.last_completed_id >= cid:
                     return
@@ -322,9 +331,12 @@ class CheckpointCoordinator:
                         f"checkpoint epoch {cid} was dropped without "
                         "committing (superseded by a newer checkpoint)")
                 if deadline is not None and time.monotonic() >= deadline:
-                    raise WindFlowError(
-                        self._fail_epoch_locked(cid, t))
-                self._commit_cond.wait(0.05)
+                    timed_out_msg = self._fail_epoch_locked(cid, t)
+                else:
+                    self._commit_cond.wait(0.05)
+            if timed_out_msg is not None:
+                self._notify_aborted(cid)
+                raise WindFlowError(timed_out_msg)
 
     # -- rescale hold point (windflow_tpu.scaling) -------------------------
     def park_if_held(self, ckpt_id: int, worker_name: str) -> Optional[str]:
@@ -375,14 +387,28 @@ class CheckpointCoordinator:
         opened against the old runtime plane can never complete once its
         workers are gone)."""
         with self._lock:
+            dropped = list(self._pending)
             self._pending.clear()
             self._retired.clear()
             self._commit_cond.notify_all()
+        for cid in dropped:
+            self._notify_aborted(cid)
+
+    def _notify_aborted(self, cid: int) -> None:
+        for fn in list(self._abort_listeners):
+            try:
+                fn(cid)
+            except Exception:
+                pass  # listener bugs must not kill the coordinator
 
     # -- listeners ---------------------------------------------------------
     def add_finalize_listener(self, fn: Callable[[int], None]) -> None:
         with self._lock:
             self._listeners.append(fn)
+
+    def add_abort_listener(self, fn: Callable[[int], None]) -> None:
+        with self._lock:
+            self._abort_listeners.append(fn)
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> Dict[str, Any]:

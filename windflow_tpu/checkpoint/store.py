@@ -31,6 +31,7 @@ import os
 import pickle
 import re
 import shutil
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -56,6 +57,23 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 
 class CheckpointStore:
+    # per-root store locks (process-wide): retain-K prune and a
+    # concurrent restore's blob reads of the SAME root serialize here,
+    # so prune can never delete a checkpoint mid-read — two graph
+    # instances (a live coordinator committing, another restoring) may
+    # share one root without coordinating
+    _root_locks: Dict[str, threading.RLock] = {}
+    _root_guard = threading.Lock()
+
+    @classmethod
+    def _lock_of(cls, root: str) -> threading.RLock:
+        key = os.path.abspath(root)
+        with cls._root_guard:
+            lock = cls._root_locks.get(key)
+            if lock is None:
+                lock = cls._root_locks[key] = threading.RLock()
+            return lock
+
     def __init__(self, root: str, retain: int = 3) -> None:
         self.root = root
         self.retain = max(1, int(retain))
@@ -112,18 +130,23 @@ class CheckpointStore:
         return final
 
     def prune(self) -> None:
-        done = self.completed_ids()
-        for cid in done[:-self.retain]:
-            shutil.rmtree(self._dirname(cid), ignore_errors=True)
-        # staging debris older than the newest committed checkpoint can
-        # never complete (its coordinator is gone) — clean it up too
-        if done:
-            for name in os.listdir(self.root):
-                if name.endswith(".inprogress"):
-                    m = _CKPT_RE.match(name[:-len(".inprogress")])
-                    if m and int(m.group(1)) <= done[-1]:
-                        shutil.rmtree(os.path.join(self.root, name),
-                                      ignore_errors=True)
+        # the whole sweep holds the per-root store lock: a concurrent
+        # restore_from= reading this root (load_states below) holds the
+        # same lock for its whole blob read, so retention can never
+        # delete a checkpoint out from under it mid-read
+        with self._lock_of(self.root):
+            done = self.completed_ids()
+            for cid in done[:-self.retain]:
+                shutil.rmtree(self._dirname(cid), ignore_errors=True)
+            # staging debris older than the newest committed checkpoint
+            # can never complete (its coordinator is gone) — clean it up
+            if done:
+                for name in os.listdir(self.root):
+                    if name.endswith(".inprogress"):
+                        m = _CKPT_RE.match(name[:-len(".inprogress")])
+                        if m and int(m.group(1)) <= done[-1]:
+                            shutil.rmtree(os.path.join(self.root, name),
+                                          ignore_errors=True)
 
     # -- reads -------------------------------------------------------------
     def completed_ids(self) -> List[int]:
@@ -184,9 +207,15 @@ class CheckpointStore:
 
     def load_states(self, ckpt_dir: str, manifest: Dict[str, Any]
                     ) -> Dict[Tuple[str, int], Any]:
-        """All replica states of one checkpoint, keyed (op name, idx)."""
+        """All replica states of one checkpoint, keyed (op name, idx).
+        The whole read holds the checkpoint root's store lock, excluding
+        a concurrent ``prune`` (a live coordinator committing into the
+        same root) for the duration — the blobs named by the manifest
+        cannot vanish halfway through the restore."""
         out: Dict[Tuple[str, int], Any] = {}
-        for fname in manifest.get("blobs", []):
-            blob = self.load_blob(ckpt_dir, fname)
-            out[(blob["op"], int(blob["replica"]))] = blob["state"]
+        with self._lock_of(os.path.dirname(os.path.abspath(ckpt_dir))
+                           or self.root):
+            for fname in manifest.get("blobs", []):
+                blob = self.load_blob(ckpt_dir, fname)
+                out[(blob["op"], int(blob["replica"]))] = blob["state"]
         return out

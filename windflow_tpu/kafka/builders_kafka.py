@@ -60,13 +60,31 @@ class Kafka_Sink_Builder(BasicBuilder):
     def __init__(self, ser_func: Callable) -> None:
         super().__init__(ser_func)
         self._brokers: Optional[str] = None
+        self._exactly_once = False
+        self._txn_dir: Optional[str] = None
 
     def with_brokers(self, brokers: str):
         self._brokers = brokers
         return self
 
+    def with_exactly_once(self, staging_dir: Optional[str] = None):
+        """Exactly-once via per-epoch broker transactions driven by
+        checkpoint finalize (transactional producer with a stable
+        ``wf-txn-<op>-r<idx>`` id; zombie replicas fenced). memory://
+        brokers model the full prepare/commit/abort/fence surface;
+        real brokers need confluent_kafka (kafka-python has no
+        transactions — build fails loudly). ``staging_dir`` holds the
+        real-broker epoch staging (default ``$WF_TXN_DIR``)."""
+        self._exactly_once = True
+        if staging_dir is not None:
+            self._txn_dir = staging_dir
+        return self
+
     def build(self) -> Kafka_Sink:
         if not self._brokers:
             raise WindFlowError("Kafka_Sink_Builder: withBrokers mandatory")
-        return self._finish(Kafka_Sink(self._func, self._brokers, self._name,
-                                       self._parallelism))
+        op = self._finish(Kafka_Sink(self._func, self._brokers, self._name,
+                                     self._parallelism))
+        op.exactly_once = self._exactly_once
+        op.txn_dir = self._txn_dir
+        return op

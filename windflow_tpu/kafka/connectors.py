@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..basic import OpType, RoutingMode, WindFlowError, current_time_usecs
 from ..operators.base import BasicOperator, BasicReplica, arity
 from ..operators.source import SourceShipper
+from ..sinks.transactional import FencedWriteError
 
 
 class KafkaMessage:
@@ -57,6 +58,16 @@ class MemoryBroker:
         # next offset) — written by MemoryTransport.commit_offsets when a
         # checkpoint finalizes, mirroring a real broker's offset store
         self.committed: Dict[Tuple[str, str, int], int] = {}
+        # transactional-producer state (exactly-once sinks): per
+        # transactional id a fence generation (zombie producers are
+        # refused, Kafka's producer-epoch fencing), prepared-but-
+        # uncommitted epoch buffers (durable across a producer's death —
+        # the analog of the broker's transaction log), and the committed
+        # epoch set (idempotent commit: a replayed epoch is discarded)
+        self.txn_fences: Dict[str, int] = {}
+        self.txn_prepared: Dict[str, Dict[int, List[Tuple]]] = {}
+        self.txn_committed: Dict[str, set] = {}
+        self.fenced_attempts = 0
 
     @classmethod
     def get(cls, name: str, n_partitions: int = 4) -> "MemoryBroker":
@@ -111,6 +122,68 @@ class MemoryBroker:
         with self._lock:
             return len(t[partition])
 
+    # -- transactions (exactly-once sinks) ---------------------------------
+    def txn_init(self, txn_id: str) -> int:
+        """(Re)initialize a transactional producer: bump the fence
+        generation — every older producer of the same id is now a zombie
+        whose writes are refused (``kafka_sink`` EOS parity with Kafka's
+        ``initTransactions`` producer-epoch bump)."""
+        with self._lock:
+            gen = self.txn_fences.get(txn_id, 0) + 1
+            self.txn_fences[txn_id] = gen
+            self.txn_prepared.setdefault(txn_id, {})
+            self.txn_committed.setdefault(txn_id, set())
+            return gen
+
+    def _txn_check(self, txn_id: str, gen: int) -> None:
+        if self.txn_fences.get(txn_id) != gen:
+            self.fenced_attempts += 1
+            raise FencedWriteError(
+                f"Kafka transactional producer {txn_id!r} generation "
+                f"{gen} is fenced (current generation "
+                f"{self.txn_fences.get(txn_id)}): a newer replica owns "
+                "this transaction log")
+
+    def txn_check(self, txn_id: str, gen: int) -> None:
+        with self._lock:
+            self._txn_check(txn_id, gen)
+
+    def txn_prepare(self, txn_id: str, gen: int, epoch: int,
+                    records: List[Tuple]) -> None:
+        """Phase 1: the epoch's records become durable in the broker's
+        transaction log, invisible to consumers until commit."""
+        with self._lock:
+            self._txn_check(txn_id, gen)
+            self.txn_prepared[txn_id][epoch] = list(records)
+
+    def txn_is_committed(self, txn_id: str, epoch: int) -> bool:
+        with self._lock:
+            return epoch in self.txn_committed.get(txn_id, ())
+
+    def txn_commit(self, txn_id: str, gen: int, epoch: int) -> bool:
+        """Phase 2: append the prepared records to their topics. False
+        when the epoch was already committed (idempotent — the replayed
+        duplicate is discarded)."""
+        with self._lock:
+            self._txn_check(txn_id, gen)
+            if epoch in self.txn_committed[txn_id]:
+                self.txn_prepared[txn_id].pop(epoch, None)
+                return False
+            records = self.txn_prepared[txn_id].pop(epoch, [])
+            self.txn_committed[txn_id].add(epoch)
+        for topic, partition, key, payload in records:
+            self.produce(topic, payload, partition, key)
+        return True
+
+    def txn_abort(self, txn_id: str, gen: int, epoch: int) -> bool:
+        with self._lock:
+            self._txn_check(txn_id, gen)
+            return self.txn_prepared[txn_id].pop(epoch, None) is not None
+
+    def txn_prepared_epochs(self, txn_id: str) -> List[int]:
+        with self._lock:
+            return sorted(self.txn_prepared.get(txn_id, {}))
+
 
 def _parse_brokers(brokers: str):
     if brokers.startswith("memory://"):
@@ -141,6 +214,8 @@ def _require_kafka_client():
 # ``kafka_source.hpp:127-519`` / ``kafka_sink.hpp:71-379``)
 # ---------------------------------------------------------------------------
 class MemoryTransport:
+    supports_transactions = True
+
     def __init__(self, name: str) -> None:
         self.broker = MemoryBroker.get(name)
         self._parts: List[Tuple[str, int]] = []
@@ -213,6 +288,8 @@ class ConfluentTransport:
     """confluent_kafka (librdkafka) adapter. ``module`` is injectable for
     tests (a fake with Consumer/Producer/TopicPartition)."""
 
+    supports_transactions = True  # librdkafka transactional producer
+
     def __init__(self, brokers: str, module=None) -> None:
         if module is None:
             import confluent_kafka as module  # noqa: PLC0415
@@ -220,6 +297,7 @@ class ConfluentTransport:
         self.brokers = brokers
         self._consumer = None
         self._producer = None
+        self._txn_producer_obj = None
         self._delivery_errors = 0
         # checkpointing turns auto-commit OFF: offsets commit only when
         # the coordinator finalizes a checkpoint (at-least-once end to
@@ -307,6 +385,44 @@ class ConfluentTransport:
         if self._consumer is not None:
             self._consumer.close()
 
+    # -- transactions (exactly-once sinks) ---------------------------------
+    def txn_produce_epoch(self, txn_id: str, records) -> None:
+        """Produce one finalized epoch atomically inside a Kafka
+        transaction: consumers in ``read_committed`` see the whole epoch
+        or none of it. The transactional id is stable per sink replica,
+        so a zombie pre-rebuild producer is fenced by the broker itself
+        (``init_transactions`` bumps the producer epoch)."""
+        ck = self._ck
+        if self._txn_producer_obj is None:
+            p = ck.Producer({"bootstrap.servers": self.brokers,
+                             "transactional.id": txn_id,
+                             "enable.idempotence": True})
+            p.init_transactions(30.0)
+            self._txn_producer_obj = p
+        p = self._txn_producer_obj
+        p.begin_transaction()
+        try:
+            for topic, partition, key, payload in records:
+                kwargs = {"on_delivery": self._on_delivery}
+                if partition is not None:
+                    kwargs["partition"] = partition
+                if key is not None:
+                    kwargs["key"] = key
+                p.produce(topic, value=payload, **kwargs)
+            remaining = p.flush(10)
+            if remaining or self._delivery_errors:
+                raise WindFlowError(
+                    f"Kafka exactly-once sink: {self._delivery_errors} "
+                    f"delivery error(s), {remaining or 0} message(s) "
+                    "unflushed inside the epoch transaction")
+            p.commit_transaction(30.0)
+        except Exception:
+            try:
+                p.abort_transaction(10.0)
+            except Exception:
+                pass  # surfacing the original failure matters more
+            raise
+
     # -- checkpointing -----------------------------------------------------
     def snapshot_positions(self) -> Dict[Tuple[str, int], int]:
         if self._consumer is None:
@@ -334,6 +450,8 @@ class ConfluentTransport:
 
 class KafkaPythonTransport:
     """kafka-python adapter (pure-python client). ``module`` injectable."""
+
+    supports_transactions = False  # no transactional producer in kafka-python
 
     def __init__(self, brokers: str, module=None) -> None:
         if module is None:
@@ -617,6 +735,10 @@ class Kafka_Sink(BasicOperator):
     to drop (``kafka_sink.hpp``: wf_kafka_sink_msg)."""
 
     op_type = OpType.SINK
+    # exactly-once mode (windflow_tpu.sinks.transactional): epoch
+    # transactions on the broker — prepared at the barrier, committed
+    # only on coordinator finalize, zombie producers fenced
+    supports_exactly_once = True
 
     def __init__(self, ser_func: Callable, brokers: str,
                  name: str = "kafka_sink", parallelism: int = 1) -> None:
@@ -627,10 +749,12 @@ class Kafka_Sink(BasicOperator):
         kind, _ = _parse_brokers(brokers)
         if kind != "memory":
             _require_kafka_client()
+        self.exactly_once = False
+        self.txn_dir: Optional[str] = None  # staging root (real brokers)
 
     def build_replicas(self) -> None:
-        self.replicas = [KafkaSinkReplica(self, i)
-                         for i in range(self.parallelism)]
+        cls = TxnKafkaSinkReplica if self.exactly_once else KafkaSinkReplica
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
 
 
 class KafkaSinkReplica(BasicReplica):
@@ -648,6 +772,189 @@ class KafkaSinkReplica(BasicReplica):
         topic, partition, data = out
         self._transport.produce(topic, data, partition)
 
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self) -> dict:
+        # flush the producer and fail LOUDLY on delivery errors before
+        # this worker's ack can let the coordinator count the epoch
+        # finalized: a lost in-flight produce used to be silent — the
+        # checkpoint then recorded source offsets past data that never
+        # reached the broker, and a restart skipped it forever
+        self._transport.flush()
+        return super().snapshot_state()
+
     def flush_on_termination(self) -> None:
+        self._transport.flush()
+        self._transport.close()
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once Kafka sink: epoch transactions driven by the checkpoint
+# coordinator (windflow_tpu.sinks.transactional)
+# ---------------------------------------------------------------------------
+class _MemoryTxnBackend:
+    """2PC backend over ``MemoryBroker``'s transaction log: prepared
+    epochs live in the broker (they survive the producer's death, like a
+    real broker's transaction markers) and zombie generations are fenced
+    broker-side."""
+
+    def __init__(self, broker: MemoryBroker, txn_id: str) -> None:
+        self.broker = broker
+        self.txn_id = txn_id
+        self.gen = broker.txn_init(txn_id)
+
+    def check_fence(self) -> None:
+        self.broker.txn_check(self.txn_id, self.gen)
+
+    def is_committed(self, epoch: int) -> bool:
+        return self.broker.txn_is_committed(self.txn_id, epoch)
+
+    def do_precommit(self, epoch: int, records) -> None:
+        self.broker.txn_prepare(self.txn_id, self.gen, epoch, records)
+
+    def do_commit(self, epoch: int):
+        self.broker.txn_commit(self.txn_id, self.gen, epoch)
+        return None  # no functor delivery: the topic IS the output
+
+    def do_abort(self, epoch: int) -> None:
+        self.broker.txn_abort(self.txn_id, self.gen, epoch)
+
+    def do_recover(self, last_epoch: int):
+        rolled, aborted = [], []
+        for epoch in self.broker.txn_prepared_epochs(self.txn_id):
+            if epoch <= last_epoch:
+                if self.broker.txn_commit(self.txn_id, self.gen, epoch):
+                    rolled.append((epoch, None))
+            else:
+                self.broker.txn_abort(self.txn_id, self.gen, epoch)
+                aborted.append(epoch)
+        return rolled, aborted
+
+
+class _StagedKafkaBackend:
+    """Real-broker backend: epochs stage durably in a local
+    ``EpochSegmentStore`` (the broker holds nothing until finalize), and
+    each commit produces the whole epoch inside one Kafka transaction
+    (``txn_produce_epoch``) so ``read_committed`` consumers see epochs
+    atomically. The local ``.seg`` rename is the commit marker; the
+    window between the broker transaction committing and the rename is
+    the one crash window that can duplicate an epoch on roll-forward
+    (closing it needs Kafka's resumable-transaction surface, which the
+    plain client API does not expose — documented in docs/API.md)."""
+
+    def __init__(self, root: str, transport, txn_id: str) -> None:
+        from ..sinks.transactional import SegmentBackend
+        self._seg = SegmentBackend(root)
+        self.transport = transport
+        self.txn_id = txn_id
+
+    def is_committed(self, epoch: int) -> bool:
+        return self._seg.is_committed(epoch)
+
+    def do_precommit(self, epoch: int, records) -> None:
+        self._seg.do_precommit(epoch, records)
+
+    def do_commit(self, epoch: int):
+        import pickle as _pickle
+        records = self._seg._records.get(epoch)
+        if records is None and not self._seg.is_committed(epoch):
+            records = _pickle.loads(self._seg.store.read(epoch,
+                                                         pending=True))
+        if records:
+            self.transport.txn_produce_epoch(self.txn_id, records)
+        self._seg.do_commit(epoch)
+        return None
+
+    def do_abort(self, epoch: int) -> None:
+        self._seg.do_abort(epoch)
+
+    def do_recover(self, last_epoch: int):
+        import pickle as _pickle
+        self._seg.store.reap_tmp()
+        rolled, aborted = [], []
+        for epoch in self._seg.store.pending_epochs():
+            if epoch <= last_epoch:
+                records = _pickle.loads(
+                    self._seg.store.read(epoch, pending=True))
+                if records:
+                    self.transport.txn_produce_epoch(self.txn_id, records)
+                self._seg.store.commit(epoch)
+                rolled.append((epoch, None))
+            else:
+                self._seg.store.abort(epoch)
+                aborted.append(epoch)
+        return rolled, aborted
+
+
+class TxnKafkaSinkReplica(KafkaSinkReplica):
+    """Kafka sink in exactly-once mode: serialized records buffer per
+    epoch, prepare on the broker (memory://) or in a local staged
+    segment (real brokers) at the barrier, and reach the topic only when
+    the coordinator finalizes the epoch. The transactional id
+    ``wf-txn-<op>-r<idx>`` is stable across restarts and rebuilds, so
+    zombie replicas left unwinding by a rescale are fenced."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        from ..sinks.transactional import EpochTxnDriver, txn_dir_for
+        txn_id = f"wf-txn-{op.name}-r{idx}"
+        if isinstance(self._transport, MemoryTransport):
+            backend = _MemoryTxnBackend(self._transport.broker, txn_id)
+        elif getattr(self._transport, "supports_transactions", False):
+            backend = _StagedKafkaBackend(
+                txn_dir_for(op.name, idx, op.txn_dir), self._transport,
+                txn_id)
+        else:
+            raise WindFlowError(
+                f"{op.name}: exactly-once needs a transactional producer "
+                "— use a memory:// broker or confluent_kafka "
+                "(kafka-python has no transactions)")
+        self._txn = EpochTxnDriver(backend, self.stats)
+        self.on_idle = self._txn.poll
+
+    def process(self, payload, ts, wm, tag):
+        out = (self.op.ser_func(payload, self.context) if self.op._riched
+               else self.op.ser_func(payload))
+        if out is None:
+            return
+        check = getattr(self._txn.backend, "check_fence", None)
+        if check is not None:
+            try:
+                check()
+            except FencedWriteError:
+                self.stats.txn_fenced_writes += 1
+                raise
+        topic, partition, data = out
+        self._txn.buffer.append((topic, partition, None, data))
+
+    def handle_msg(self, ch, msg):
+        t = self._txn
+        if t._pending and min(t._pending) <= t._commit_ready:
+            t.poll()
+        super().handle_msg(ch, msg)
+
+    # -- worker / coordinator hooks ----------------------------------------
+    def bind_txn_coordinator(self, coordinator) -> None:
+        self._txn.bind(coordinator)
+
+    def precommit_epoch(self, ckpt_id: int) -> None:
+        self._txn.precommit_epoch(ckpt_id)
+
+    def snapshot_state(self) -> dict:
+        st = BasicReplica.snapshot_state(self)  # no blind producer flush:
+        st.update(self._txn.snapshot())  # records ride the epoch txn
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        BasicReplica.restore_state(self, state)
+        self._txn.restore(state)
+
+    def flush_on_termination(self) -> None:
+        # EOS: stage the post-barrier tail as one final epoch; it (and
+        # any not-yet-finalized epoch) commits in txn_complete once the
+        # run is known to have finished cleanly
+        self._txn.seal_tail()
+
+    def txn_complete(self) -> None:
+        self._txn.complete_all()
         self._transport.flush()
         self._transport.close()

@@ -159,6 +159,18 @@ _PROM_SCALARS = (
     ("windflow_checkpoint_align_stall_seconds_total", "counter",
      "Time multi-input workers stalled aligning checkpoint barriers",
      "Checkpoint_align_stall_usec_total", 1e-6),
+    ("windflow_sink_txn_precommits_total", "counter",
+     "Exactly-once sink epochs pre-committed at the aligned barrier",
+     "Sink_txn_precommits", 1),
+    ("windflow_sink_txn_commits_total", "counter",
+     "Exactly-once sink epochs committed on coordinator finalize",
+     "Sink_txn_commits", 1),
+    ("windflow_sink_txn_aborts_total", "counter",
+     "Exactly-once sink epochs aborted (restore discard / replayed "
+     "duplicate)", "Sink_txn_aborts", 1),
+    ("windflow_sink_txn_fenced_writes_total", "counter",
+     "Writes refused from stale (zombie) exactly-once sink replicas",
+     "Sink_txn_fenced_writes", 1),
     ("windflow_compile_total", "counter",
      "XLA (re)trace+compiles of the replica's device programs",
      "Compile_count", 1),

@@ -40,6 +40,11 @@ class StatsRecord:
         "checkpoints_taken", "checkpoint_snapshot_total_us",
         "checkpoint_last_snapshot_us", "checkpoint_bytes_total",
         "checkpoint_align_total_us",
+        # exactly-once sinks (windflow_tpu.sinks.transactional): per-epoch
+        # two-phase-commit accounting — pre-commits at the barrier,
+        # commits on coordinator finalize, aborts on restore/duplicate
+        # discard, and fenced (refused) writes from stale zombie replicas
+        "txn_precommits", "txn_commits", "txn_aborts", "txn_fenced_writes",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -104,6 +109,10 @@ class StatsRecord:
         self.checkpoint_last_snapshot_us = 0.0
         self.checkpoint_bytes_total = 0
         self.checkpoint_align_total_us = 0.0
+        self.txn_precommits = 0
+        self.txn_commits = 0
+        self.txn_aborts = 0
+        self.txn_fenced_writes = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -281,6 +290,11 @@ class StatsRecord:
             "Checkpoint_bytes_total": self.checkpoint_bytes_total,
             "Checkpoint_align_stall_usec_total": round(
                 self.checkpoint_align_total_us, 1),
+            # exactly-once sink 2PC (0s unless with_exactly_once)
+            "Sink_txn_precommits": self.txn_precommits,
+            "Sink_txn_commits": self.txn_commits,
+            "Sink_txn_aborts": self.txn_aborts,
+            "Sink_txn_fenced_writes": self.txn_fenced_writes,
             # XLA compile attribution (flightrec.instrumented_jit wraps
             # the device plane's jit entry points; 0/"" on CPU replicas)
             "Compile_count": self.compile_count,

@@ -182,6 +182,11 @@ class ReduceReplica(BasicReplica):
 # --------------------------------------------------------------------------
 class Sink(BasicOperator):
     op_type = OpType.SINK
+    # exactly-once mode (windflow_tpu.sinks.transactional): output
+    # buffers per checkpoint epoch, pre-commits at the barrier as a
+    # staged segment file and becomes visible (tmp+atomic-rename) only
+    # when the coordinator finalizes the epoch
+    supports_exactly_once = True
 
     def __init__(self, func: Callable, name: str = "sink", parallelism: int = 1,
                  input_routing: RoutingMode = RoutingMode.FORWARD,
@@ -197,9 +202,15 @@ class Sink(BasicOperator):
         # ``wf/batch_gpu_t.hpp:154-179``)
         self.accepts_columns = accepts_columns
         self._riched = arity(func) >= (3 if accepts_columns else 2)
+        self.exactly_once = False
+        self.txn_dir: Optional[str] = None
 
     def build_replicas(self) -> None:
-        cls = ColumnarSinkReplica if self.accepts_columns else SinkReplica
+        if self.exactly_once:
+            cls = (TxnColumnarSinkReplica if self.accepts_columns
+                   else TxnSinkReplica)
+        else:
+            cls = ColumnarSinkReplica if self.accepts_columns else SinkReplica
         self.replicas = [cls(self, i) for i in range(self.parallelism)]
 
 
@@ -263,14 +274,128 @@ class ColumnarSinkReplica(BasicReplica):
                     for name, col in msg.fields.items()}
             ts = msg.ts_host[:n]
             self.context._set_meta(int(ts[-1]) if n else 0, self.cur_wm)
-            if self.op._riched:
-                self.op.func(cols, ts, self.context)
-            else:
-                self.op.func(cols, ts)
+            self._consume(cols, ts)
         self.stats.end_svc(n)
+
+    def _consume(self, cols, ts) -> None:
+        """One host column batch -> the user functor (the exactly-once
+        subclass buffers it into the current epoch instead)."""
+        if self.op._riched:
+            self.op.func(cols, ts, self.context)
+        else:
+            self.op.func(cols, ts)
 
     def flush_on_termination(self) -> None:
         if self.op._riched:
             self.op.func(None, None, self.context)
         else:
             self.op.func(None, None)
+
+
+# --------------------------------------------------------------------------
+# Exactly-once sinks (windflow_tpu.sinks.transactional): two-phase commit
+# driven by the checkpoint coordinator. Separate subclasses so the default
+# at-least-once hot path is byte-identical to before — the exactly-once
+# machinery costs nothing unless with_exactly_once() selected it.
+# --------------------------------------------------------------------------
+class _TxnSinkMixin:
+    """Chain-node hooks shared by the row and columnar transactional
+    sinks; the 2PC state machine lives in ``EpochTxnDriver``."""
+
+    def _init_txn(self) -> None:
+        from ..sinks.transactional import (EpochTxnDriver, SegmentBackend,
+                                           txn_dir_for)
+        self.txn_root = txn_dir_for(self.op.name, self.idx, self.op.txn_dir)
+        self._txn = EpochTxnDriver(SegmentBackend(self.txn_root), self.stats,
+                                   deliver=self._deliver)
+        # instance attribute so the worker's idle tick drives commits
+        # (plain sinks have no on_idle and stay off the idle-tick path)
+        self.on_idle = self._txn.poll
+
+    # -- worker / coordinator hooks (runtime/worker.py) --------------------
+    def bind_txn_coordinator(self, coordinator) -> None:
+        self._txn.bind(coordinator)
+
+    def precommit_epoch(self, ckpt_id: int) -> None:
+        self._txn.precommit_epoch(ckpt_id)
+
+    def handle_msg(self, ch: int, msg: Any) -> None:
+        # commit finalized epochs from our OWN thread before the next
+        # message (the finalize listener only flips a watermark); the
+        # fast path inside poll() is one int compare per message
+        t = self._txn
+        if t._pending and min(t._pending) <= t._commit_ready:
+            t.poll()
+        super().handle_msg(ch, msg)
+
+    def flush_on_termination(self) -> None:
+        # EOS in exactly-once mode: commit what is finalized, stage the
+        # post-barrier tail as one last pending epoch. Functor delivery
+        # of still-pending epochs (and the EOS None marker) happens in
+        # txn_complete once the whole graph finished cleanly.
+        self._txn.seal_tail()
+
+    def txn_complete(self) -> None:
+        """Called by ``PipeGraph.wait_end`` on a clean finish (worker
+        joined, no errors): commit every remaining epoch in order, then
+        hand the functor its EOS marker."""
+        self._txn.complete_all()
+        self._eos_marker()
+
+    # -- checkpoint snapshot / restore -------------------------------------
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st.update(self._txn.snapshot())
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self._txn.restore(state)
+
+
+class TxnSinkReplica(_TxnSinkMixin, SinkReplica):
+    """Row sink in exactly-once mode: tuples buffer per epoch; the
+    committed ``epoch_*.seg`` files under ``txn_root`` are the durable
+    output stream, and the functor sees each record exactly once, at
+    commit time (epoch order)."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self._init_txn()
+
+    def process(self, payload, ts, wm, tag):
+        self._txn.buffer.append((payload, ts))
+
+    def _deliver(self, records) -> None:
+        for payload, ts in records:
+            self.context._set_meta(ts, self.cur_wm)
+            if self.op._riched:
+                self.op.func(payload, self.context)
+            else:
+                self.op.func(payload)
+
+    def _eos_marker(self) -> None:
+        SinkReplica.flush_on_termination(self)
+
+
+class TxnColumnarSinkReplica(_TxnSinkMixin, ColumnarSinkReplica):
+    """Columnar sink in exactly-once mode: whole host column batches
+    buffer per epoch (the arrays are already host copies at this point),
+    one functor call per batch at commit time."""
+
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        self._init_txn()
+
+    def _consume(self, cols, ts) -> None:
+        self._txn.buffer.append((cols, ts))
+
+    def _deliver(self, records) -> None:
+        for cols, ts in records:
+            if self.op._riched:
+                self.op.func(cols, ts, self.context)
+            else:
+                self.op.func(cols, ts)
+
+    def _eos_marker(self) -> None:
+        ColumnarSinkReplica.flush_on_termination(self)

@@ -92,6 +92,24 @@ class P_Sink_Builder(_PersistentBuilder):
     _default_name = "p_sink"
     op_cls = P_Sink
 
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._exactly_once = False
+
+    def with_exactly_once(self):
+        """Exactly-once via the epoch-fenced sqlite writer: data and the
+        ``epoch`` marker commit in one sqlite transaction at the barrier,
+        the ``finalized`` marker advances only on coordinator finalize,
+        and a stale (pre-rescale zombie) replica generation is refused by
+        the in-DB fence before it can commit anything."""
+        self._exactly_once = True
+        return self
+
+    def build(self):
+        op = super().build()
+        op.exactly_once = self._exactly_once
+        return op
+
 
 class P_Keyed_Windows_Builder(_PersistentBuilder):
     _default_name = "p_keyed_windows"

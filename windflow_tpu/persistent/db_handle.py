@@ -84,6 +84,28 @@ class DBHandle:
     def __len__(self) -> int:
         return self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
 
+    # -- transaction metadata (exactly-once sinks) -------------------------
+    # One tiny side table holds the 2PC bookkeeping INSIDE the same
+    # database file, so an epoch marker and its data commit in one sqlite
+    # transaction and snapshot/restore carries both: 'fence' (replica
+    # generation — stale writers are refused), 'epoch' (last pre-committed
+    # epoch) and 'finalized' (last epoch the coordinator finalized).
+    def _ensure_meta(self) -> None:
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS wf_txn (k TEXT PRIMARY KEY, v INTEGER)")
+
+    def meta_get(self, key: str) -> Optional[int]:
+        self._ensure_meta()
+        row = self._conn.execute("SELECT v FROM wf_txn WHERE k = ?",
+                                 (key,)).fetchone()
+        return None if row is None else int(row[0])
+
+    def meta_put(self, key: str, value: int) -> None:
+        self._ensure_meta()
+        self._conn.execute(
+            "INSERT INTO wf_txn (k, v) VALUES (?, ?) "
+            "ON CONFLICT(k) DO UPDATE SET v = excluded.v", (key, int(value)))
+
     def commit(self) -> None:
         """Durable, atomic commit of all pending puts/deletes.
 

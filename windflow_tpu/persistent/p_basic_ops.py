@@ -202,6 +202,17 @@ class P_Sink(_PersistentOperator):
     """func(Optional[tuple], state) -> new_state per tuple; None at EOS."""
 
     op_type = OpType.SINK
+    # exactly-once mode: the sqlite file carries the 2PC epoch marker and
+    # a replica-generation fence (windflow_tpu.sinks.transactional)
+    supports_exactly_once = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.exactly_once = False
+
+    def build_replicas(self) -> None:
+        cls = PTxnSinkReplica if self.exactly_once else PSinkReplica
+        self.replicas = [cls(self, i) for i in range(self.parallelism)]
 
 
 class PSinkReplica(_PersistentReplica):
@@ -222,3 +233,134 @@ class PSinkReplica(_PersistentReplica):
 
 
 P_Sink.replica_cls = PSinkReplica
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once persistent sink: epoch-fenced sqlite writer
+# ---------------------------------------------------------------------------
+class _PSinkTxnBackend:
+    """2PC backend over the replica's own sqlite file. The staged state
+    IS the database: between barriers every write sits in the cache or
+    the open implicit sqlite transaction; pre-commit spills the cache and
+    commits data + ``epoch`` marker atomically; phase-2 commit only
+    advances the ``finalized`` marker (the visibility watermark external
+    readers compare against ``epoch``). Restore replaces the whole file
+    with the checkpoint image, so roll-forward/abort reduce to stamping
+    the markers at the restored epoch. Every durable step first checks
+    the generation fence — a zombie pre-rescale replica is refused before
+    it can commit anything."""
+
+    always_seal = True  # the tail epoch lives in the DB, not in buffer
+
+    def __init__(self, replica: "PTxnSinkReplica") -> None:
+        self.r = replica
+
+    def do_precommit(self, epoch: int, records) -> None:
+        r = self.r
+        r._check_fence()
+        for k, v in list(r.state.cache.items()):
+            r.db.put(k, v)
+        r.db.meta_put("epoch", epoch)
+        r.db.commit()
+
+    def do_commit(self, epoch: int):
+        r = self.r
+        r._check_fence()
+        r.db.meta_put("finalized", epoch)
+        r.db.commit()
+        return None
+
+    def do_abort(self, epoch: int) -> None:
+        pass  # nothing staged outside the DB image
+
+    def do_recover(self, last_epoch: int):
+        # the checkpoint image (already restored into the file by
+        # restore_state) is exactly the barrier state of ``last_epoch``:
+        # stamp both markers there and re-assert this replica's fence
+        # over whatever generation the image recorded
+        r = self.r
+        r.db.meta_put("fence", r._fence)
+        r.db.meta_put("epoch", last_epoch)
+        r.db.meta_put("finalized", last_epoch)
+        r.db.commit()
+        return [], []
+
+
+class PTxnSinkReplica(PSinkReplica):
+    def __init__(self, op, idx):
+        super().__init__(op, idx)
+        from ..sinks.transactional import EpochTxnDriver
+        # acquire this replica generation's fence token: one atomic bump
+        # of the in-DB generation — rebuilding the runtime plane (a live
+        # rescale, a restore) creates a new replica and fences the old
+        self._fence = (self.db.meta_get("fence") or 0) + 1
+        self.db.meta_put("fence", self._fence)
+        self.db.commit()
+        self._txn = EpochTxnDriver(_PSinkTxnBackend(self), self.stats)
+        self.on_idle = self._txn.poll
+
+    def _check_fence(self) -> None:
+        # accounting (Sink_txn_fenced_writes + the txn:fenced span)
+        # happens in the driver, which wraps every backend verb
+        from ..sinks.transactional import FencedWriteError
+        stored = self.db.meta_get("fence")
+        if stored != self._fence:
+            raise FencedWriteError(
+                f"{self.op.name} replica {self.idx}: sqlite epoch fence "
+                f"{self._fence} is stale (current {stored}); a newer "
+                "replica generation owns this database — refusing the "
+                "write")
+
+    # -- worker / coordinator hooks ----------------------------------------
+    def bind_txn_coordinator(self, coordinator) -> None:
+        self._txn.bind(coordinator)
+
+    def precommit_epoch(self, ckpt_id: int) -> None:
+        self._txn.precommit_epoch(ckpt_id)
+
+    def handle_msg(self, ch, msg):
+        t = self._txn
+        if t._pending and min(t._pending) <= t._commit_ready:
+            t.poll()
+        super().handle_msg(ch, msg)
+
+    # -- checkpointing ------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        # the precommit hook already spilled + committed the epoch; the
+        # inherited snapshot captures the image (markers included)
+        st = super().snapshot_state()
+        st.update(self._txn.snapshot())
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)  # replaces the DB with the image
+        self._txn.restore(state)      # -> do_recover stamps markers+fence
+
+    def flush_on_termination(self) -> None:
+        # per-key EOS finalization mutates state like normal processing:
+        # it belongs to the tail epoch, staged (pre-committed) here and
+        # finalized in txn_complete on a clean end of run
+        for key, st in list(self.state.items()):
+            out = self._call(None, st)
+            if out is not None:
+                self.state[key] = out
+        self._txn.seal_tail()
+
+    def terminate(self) -> None:
+        # keep the DB open: txn_complete still has markers to commit
+        if self.terminated:
+            return
+        self.terminated = True
+        self.flush_on_termination()
+        if self.op.closing_func is not None:
+            if arity(self.op.closing_func) >= 1:
+                self.op.closing_func(self.context)
+            else:
+                self.op.closing_func()
+        if self.emitter is not None:
+            self.emitter.flush()
+        self.stats.is_terminated = True
+
+    def txn_complete(self) -> None:
+        self._txn.complete_all()
+        self.db.close()

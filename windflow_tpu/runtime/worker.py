@@ -85,6 +85,14 @@ class Worker(threading.Thread):
             bind = getattr(chain[0], "bind_checkpoint", None)
             if bind is not None:
                 bind(coordinator, self.checkpoint_now)
+        if coordinator is not None:
+            # exactly-once sinks (windflow_tpu.sinks.transactional):
+            # register their commit-on-finalize listener with the
+            # coordinator that drives their epochs
+            for n in self._replicas:
+                bind = getattr(n, "bind_txn_coordinator", None)
+                if bind is not None:
+                    bind(coordinator)
 
     def run(self) -> None:
         if self.flightrec is not None:
@@ -283,6 +291,14 @@ class Worker(threading.Thread):
                 em.flush()  # inline edge: feeds the next fused node now
         if last is not None and last.emitter is not None:
             last.emitter.send_barrier_all(barrier)
+        # exactly-once sinks pre-commit the epoch BEFORE the blobs are
+        # captured (and before our ack can let the coordinator finalize
+        # it): everything staged since the previous barrier becomes this
+        # epoch's durable, not-yet-visible segment/transaction
+        for node in replicas:
+            hook = getattr(node, "precommit_epoch", None)
+            if hook is not None:
+                hook(barrier.ckpt_id)
         nbytes = coord.ack(barrier.ckpt_id, self.name,
                            self._capture_blobs())
         stats = self._stats()

@@ -64,6 +64,11 @@ def repartition_refusal(op) -> Optional[str]:
     if op.op_type == OpType.SOURCE:
         return ("source replicas are independent generators; their replay "
                 "cursors are positions, not keyed state")
+    if getattr(op, "exactly_once", False):
+        return ("exactly-once sinks own per-replica transaction logs "
+                "(staged epoch segments / transactional producer ids); "
+                "changing the replica count would orphan staged epochs "
+                "and break the commit fencing")
     mod = type(op).__module__
     if ".persistent." in mod:
         return ("persistent (sqlite-backed) state is a per-replica DB "

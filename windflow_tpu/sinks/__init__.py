@@ -1,0 +1,15 @@
+"""Sink-side delivery guarantees (no reference analog).
+
+``windflow_tpu.sinks.transactional`` upgrades every sink family from
+at-least-once to exactly-once via an epoch-fenced two-phase commit driven
+by the aligned-barrier checkpoint plane (``windflow_tpu.checkpoint``):
+sink output buffers/stages per epoch, pre-commits at barrier-snapshot
+time, and becomes visible atomically only when the coordinator finalizes
+the epoch.
+"""
+
+from .transactional import (EpochSegmentStore, EpochTxnDriver,
+                            FencedWriteError, txn_dir_for)
+
+__all__ = ["EpochSegmentStore", "EpochTxnDriver", "FencedWriteError",
+           "txn_dir_for"]

@@ -1,0 +1,412 @@
+"""Exactly-once sinks: epoch-fenced two-phase commit on checkpoint finalize.
+
+PR 3's aligned-barrier checkpointing gives at-least-once delivery: on
+recovery the sources replay the suffix after the barrier and every sink
+re-emits it. This module closes the gap with a classic two-phase commit
+whose coordinator is the existing ``CheckpointCoordinator``:
+
+- between barriers a sink replica stages its output under the CURRENT
+  epoch (an in-memory buffer for functor sinks, an open broker
+  transaction for Kafka, an uncommitted sqlite transaction for P_Sink);
+- at barrier-snapshot time (``Worker.checkpoint_now`` calls the replica's
+  ``precommit_epoch(ckpt_id)`` hook) the epoch is **pre-committed**:
+  made durable but not yet visible — a staged segment file published with
+  tmp+atomic-rename, a prepared broker transaction, a committed sqlite
+  image carrying the epoch marker;
+- when the coordinator finalizes the epoch, a finalize listener flips a
+  watermark and the sink's own thread **commits** every pre-committed
+  epoch at or below it (rename ``.pending`` -> ``.seg``, broker
+  transaction commit, sqlite finalized-epoch marker);
+- on restore from checkpoint ``cid``, pre-committed epochs ``<= cid``
+  roll FORWARD (their records are pre-barrier data the replay will not
+  regenerate) and epochs ``> cid`` abort (the replayed suffix regenerates
+  them) — so kill-anywhere / restore / compare yields byte-identical,
+  duplicate-free sink output.
+
+Epoch fencing: a replica instance acquires a monotonically increasing
+fence token when it opens its transaction log (broker transactional id /
+sqlite meta row). Rebuilding the runtime plane — a live ``rescale()``,
+or a restore — bumps the fence, and any write or commit attempted by a
+stale pre-rebuild replica raises ``FencedWriteError`` instead of
+corrupting the committed stream (Kafka's zombie-producer fencing,
+generalized to every sink family).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..basic import WindFlowError
+
+
+class FencedWriteError(WindFlowError):
+    """A stale (zombie) sink replica attempted a transactional write
+    after a newer replica generation took over its log."""
+
+
+def txn_dir_for(op_name: str, replica_idx: int,
+                base: Optional[str] = None) -> str:
+    """Default staging root for one sink replica's transaction log:
+    ``<WF_TXN_DIR or wf_txn_sinks>/<sanitized op>_r<idx>``."""
+    root = base or os.environ.get("WF_TXN_DIR") or "wf_txn_sinks"
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in op_name)
+    return os.path.join(root, f"{safe}_r{replica_idx}")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+_SEG_RE = re.compile(r"^epoch_(\d{10})\.(pending|seg)$")
+
+
+class EpochSegmentStore:
+    """One sink replica's on-disk transaction log: one staged segment per
+    epoch, crash-safe by construction (the same tmp+atomic-rename
+    discipline as ``checkpoint/store.py``)::
+
+        <root>/
+          epoch_0000000003.pending   # pre-committed (durable, invisible)
+          epoch_0000000002.seg       # committed (the sink's real output)
+
+    ``precommit`` publishes the pending file atomically; ``commit`` is a
+    single ``os.replace`` of ``.pending`` to ``.seg``; both are
+    idempotent so a crash between the coordinator finalize and the
+    sink-side rename is healed by roll-forward on restore. Orphaned
+    ``.tmp`` debris from a crash mid-precommit is reaped on recovery.
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, epoch: int, pending: bool) -> str:
+        return os.path.join(
+            self.root, f"epoch_{epoch:010d}.{'pending' if pending else 'seg'}")
+
+    # -- the 2PC verbs -----------------------------------------------------
+    def precommit(self, epoch: int, payload: bytes) -> str:
+        path = self._path(epoch, pending=True)
+        _atomic_write(path, payload)
+        return path
+
+    def commit(self, epoch: int) -> bool:
+        """``.pending`` -> ``.seg``; True when this call performed the
+        rename (False: already committed — the idempotent replay case)."""
+        final = self._path(epoch, pending=False)
+        if os.path.exists(final):
+            return False
+        pending = self._path(epoch, pending=True)
+        os.replace(pending, final)  # missing pending = a real bug: raise
+        return True
+
+    def abort(self, epoch: int) -> bool:
+        try:
+            os.unlink(self._path(epoch, pending=True))
+            return True
+        except FileNotFoundError:
+            return False
+
+    # -- introspection / recovery ------------------------------------------
+    def _scan(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _SEG_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), m.group(2)))
+        return sorted(out)
+
+    def pending_epochs(self) -> List[int]:
+        return [e for e, kind in self._scan() if kind == "pending"]
+
+    def committed_epochs(self) -> List[int]:
+        return [e for e, kind in self._scan() if kind == "seg"]
+
+    def is_committed(self, epoch: int) -> bool:
+        return os.path.exists(self._path(epoch, pending=False))
+
+    def read(self, epoch: int, pending: bool = False) -> bytes:
+        with open(self._path(epoch, pending), "rb") as f:
+            return f.read()
+
+    def reap_tmp(self) -> int:
+        """Delete torn ``.tmp`` files a crash mid-precommit left behind."""
+        n = 0
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return 0
+        for name in names:
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                    n += 1
+                except OSError:
+                    pass
+        return n
+
+
+def read_committed_records(root: str) -> List[Any]:
+    """All committed records of one replica's segment store, concatenated
+    in epoch order — the canonical 'what did this sink output' view the
+    exactly-once differentials compare."""
+    store = EpochSegmentStore(root)
+    out: List[Any] = []
+    for epoch in store.committed_epochs():
+        out.extend(pickle.loads(store.read(epoch)))
+    return out
+
+
+class EpochTxnDriver:
+    """Shared two-phase-commit state machine for one sink replica.
+
+    The family-specific mechanics live in a small backend object with
+    the verbs ``do_precommit(epoch, records)``, ``do_commit(epoch) ->
+    Optional[records]`` (the returned records are handed to ``deliver``,
+    the functor-delivery callback), ``do_abort(epoch)`` and
+    ``do_recover(last_epoch) -> (rolled_forward, aborted)``. The driver
+    owns epoch bookkeeping, the finalize watermark, commit-latency
+    accounting, and the ``Sink_txn_*`` stats + ``txn:*`` flight spans.
+
+    Threading: ``on_finalized`` runs on whichever worker thread acked
+    last (the coordinator contract) and only stores an int watermark;
+    every other method runs on the sink replica's own thread (or the
+    main thread, for ``restore``/``complete_all`` — worker joined).
+    """
+
+    def __init__(self, backend: Any, stats: Any,
+                 deliver: Optional[Callable[[Any], None]] = None) -> None:
+        self.backend = backend
+        self.stats = stats
+        self.deliver = deliver
+        self.buffer: List[Any] = []  # current-epoch records (file flavor)
+        self._pending: Dict[int, float] = {}  # epoch -> precommit t
+        self._commit_ready = 0  # finalize watermark (listener-written)
+        self.last_epoch = 0
+        # commit-latency accounting (precommit -> commit visible), for
+        # microbench --txn and the PERF.md numbers
+        self.commit_latency_last_us = 0.0
+        self.commit_latency_total_us = 0.0
+        self.commits = 0
+
+    # -- wiring ------------------------------------------------------------
+    def bind(self, coordinator: Any) -> None:
+        self._commit_ready = coordinator.last_completed_id
+        coordinator.add_finalize_listener(self.on_finalized)
+        abort_bind = getattr(coordinator, "add_abort_listener", None)
+        if abort_bind is not None:
+            abort_bind(self.on_epoch_failed)
+
+    def on_finalized(self, ckpt_id: int) -> None:
+        # another worker's thread: publish the watermark only
+        if ckpt_id > self._commit_ready:
+            self._commit_ready = ckpt_id
+
+    def on_epoch_failed(self, ckpt_id: int) -> None:
+        """Coordinator abort path (epoch timeout / rescale teardown): the
+        epoch will never finalize, but its pre-committed records are
+        still pre-barrier data — they stay staged and ride the next
+        committed epoch's watermark (or roll forward/abort on restore).
+        Record the event so the abandonment is visible."""
+        self._span("txn:epoch_failed", 0.0, {"epoch": ckpt_id})
+
+    def _span(self, name: str, dur_us: float, arg: Any = None) -> None:
+        rec = getattr(self.stats, "recorder", None)
+        if rec is not None:
+            try:
+                rec.event(name, dur_us, arg)
+            except Exception:
+                pass  # telemetry must never fail a commit
+
+    def _fenced(self, exc: BaseException) -> None:
+        """Uniform accounting for a refused zombie write, whichever
+        backend detected it."""
+        self.stats.txn_fenced_writes += 1
+        self._span("txn:fenced", 0.0, str(exc))
+
+    # -- phase 1: pre-commit at the aligned barrier ------------------------
+    def precommit_epoch(self, ckpt_id: int) -> None:
+        """Worker hook at barrier-snapshot time: everything staged since
+        the previous barrier belongs to epoch ``ckpt_id``. Commits any
+        already-finalized older epoch first (keeps disk bounded), then
+        durably prepares this one. An epoch that is ALREADY committed in
+        the log (restore from an older checkpoint replayed it) is
+        discarded instead — the sink-side duplicate filter."""
+        self.poll()
+        records, self.buffer = self.buffer, []
+        self.last_epoch = max(self.last_epoch, ckpt_id)
+        already = getattr(self.backend, "is_committed", None)
+        if already is not None and already(ckpt_id):
+            self.stats.txn_aborts += 1
+            self._span("txn:discard_committed", 0.0,
+                       {"epoch": ckpt_id, "records": len(records)})
+            return
+        t0 = time.perf_counter()
+        try:
+            self.backend.do_precommit(ckpt_id, records)
+        except FencedWriteError as e:
+            self._fenced(e)
+            raise
+        self._pending[ckpt_id] = time.perf_counter()
+        self.stats.txn_precommits += 1
+        self._span("txn:precommit", (time.perf_counter() - t0) * 1e6,
+                   {"epoch": ckpt_id, "records": len(records)})
+
+    # -- phase 2: commit on coordinator finalize ---------------------------
+    def poll(self) -> bool:
+        """Commit every pre-committed epoch at or below the finalize
+        watermark (epoch order). Called from the sink's own thread: the
+        message path, the worker idle tick, and the barrier hook."""
+        ready = self._commit_ready
+        did = False
+        for epoch in sorted(e for e in self._pending if e <= ready):
+            self._commit_one(epoch)
+            did = True
+        return did
+
+    def _commit_one(self, epoch: int) -> None:
+        t_pre = self._pending.pop(epoch)
+        t0 = time.perf_counter()
+        try:
+            records = self.backend.do_commit(epoch)
+        except FencedWriteError as e:
+            self._pending[epoch] = t_pre  # still staged; not ours anymore
+            self._fenced(e)
+            raise
+        now = time.perf_counter()
+        lat_us = (now - t_pre) * 1e6
+        self.commit_latency_last_us = lat_us
+        self.commit_latency_total_us += lat_us
+        self.commits += 1
+        self.stats.txn_commits += 1
+        self._span("txn:commit", (now - t0) * 1e6,
+                   {"epoch": epoch, "latency_us": round(lat_us, 1)})
+        if records is not None and self.deliver is not None:
+            self.deliver(records)
+
+    # -- termination -------------------------------------------------------
+    def seal_tail(self) -> None:
+        """EOS: stage the records after the last barrier as one final
+        epoch (``last_epoch + 1``); it commits in ``complete_all`` once
+        the graph is known to have finished cleanly. A crash before that
+        aborts it on restore — the replay regenerates the tail."""
+        self.poll()
+        if not self.buffer and not hasattr(self.backend, "always_seal"):
+            return
+        self.precommit_epoch(self.last_epoch + 1)
+
+    def complete_all(self) -> None:
+        """Clean end of the run (``PipeGraph.wait_end``, every worker
+        joined without error): the stream is complete and nothing will
+        replay, so every still-pending epoch — finalized or merely
+        superseded — commits now, in epoch order."""
+        for epoch in sorted(self._pending):
+            self._commit_one(epoch)
+
+    # -- checkpoint snapshot / restore -------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {"txn_last_epoch": self.last_epoch}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Recovery: roll pre-committed epochs ``<= txn_last_epoch`` (the
+        restored checkpoint's id — their data precedes the replay point)
+        forward to committed; abort everything newer (the replayed
+        suffix regenerates it)."""
+        last = int(state.get("txn_last_epoch", 0))
+        self.last_epoch = last
+        self._commit_ready = max(self._commit_ready, last)
+        t0 = time.perf_counter()
+        rolled, aborted = self.backend.do_recover(last)
+        for epoch, records in rolled:
+            self.commits += 1
+            self.stats.txn_commits += 1
+            if records is not None and self.deliver is not None:
+                self.deliver(records)
+        self.stats.txn_aborts += len(aborted)
+        if rolled or aborted:
+            self._span("txn:recover", (time.perf_counter() - t0) * 1e6,
+                       {"rolled_forward": [e for e, _ in rolled],
+                        "aborted": list(aborted)})
+
+
+class SegmentBackend:
+    """File-flavor backend over :class:`EpochSegmentStore` — used by the
+    row (``SinkReplica``) and columnar (``ColumnarSinkReplica``) sinks.
+    Records are pickled per epoch; the committed ``.seg`` files are the
+    sink's durable, exactly-once output stream.
+
+    Fencing: a ``fence`` file in the segment root holds the current
+    replica generation. Constructing a backend (a restore, a live
+    rescale rebuilding the runtime plane) bumps it atomically; a stale
+    pre-rebuild replica fails its next precommit/commit instead of
+    racing the new owner's renames."""
+
+    def __init__(self, root: str) -> None:
+        self.store = EpochSegmentStore(root)
+        self._records: Dict[int, List[Any]] = {}  # uncommitted, in-memory
+        self._fence_path = os.path.join(root, "fence")
+        self.fence = self._read_fence() + 1
+        _atomic_write(self._fence_path, str(self.fence).encode())
+
+    def _read_fence(self) -> int:
+        try:
+            with open(self._fence_path, "rb") as f:
+                return int(f.read() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def check_fence(self) -> None:
+        stored = self._read_fence()
+        if stored != self.fence:
+            raise FencedWriteError(
+                f"segment store {self.store.root!r}: fence {self.fence} "
+                f"is stale (current {stored}); a newer replica "
+                "generation owns this transaction log")
+
+    def is_committed(self, epoch: int) -> bool:
+        return self.store.is_committed(epoch)
+
+    def do_precommit(self, epoch: int, records: List[Any]) -> None:
+        self.check_fence()
+        self.store.precommit(epoch, pickle.dumps(
+            records, protocol=pickle.HIGHEST_PROTOCOL))
+        self._records[epoch] = records
+
+    def do_commit(self, epoch: int) -> Optional[List[Any]]:
+        self.check_fence()
+        if not self.store.commit(epoch):
+            self._records.pop(epoch, None)
+            return None  # already committed: do not re-deliver
+        return self._records.pop(epoch, None)
+
+    def do_abort(self, epoch: int) -> None:
+        self._records.pop(epoch, None)
+        self.store.abort(epoch)
+
+    def do_recover(self, last_epoch: int
+                   ) -> Tuple[List[Tuple[int, Any]], List[int]]:
+        self.store.reap_tmp()
+        rolled: List[Tuple[int, Any]] = []
+        aborted: List[int] = []
+        for epoch in self.store.pending_epochs():
+            if epoch <= last_epoch:
+                payload = self.store.read(epoch, pending=True)
+                if self.store.commit(epoch):
+                    rolled.append((epoch, pickle.loads(payload)))
+            else:
+                self.store.abort(epoch)
+                aborted.append(epoch)
+        return rolled, aborted

@@ -76,6 +76,11 @@ class PipeGraph:
         # scale-down surface ONCE more (Final=true) in get_stats, then
         # vanish — Prometheus sees a clean series end, not a frozen value
         self._final_series: List[Dict[str, Any]] = []
+        # exactly-once sinks (windflow_tpu.sinks.transactional): the
+        # graph-wide switch flips every sink that supports the 2PC
+        # protocol; per-sink builders (`with_exactly_once()`) opt in
+        # individually. Env twin: WF_EXACTLY_ONCE=1
+        self._exactly_once = env_flag("WF_EXACTLY_ONCE")
         env_iv = os.environ.get("WF_CKPT_INTERVAL")
         if env_iv:
             try:
@@ -84,6 +89,54 @@ class PipeGraph:
                 pass  # malformed knob must not take down the graph
         if os.environ.get("WF_CKPT_DIR"):
             self._ckpt_dir = os.environ["WF_CKPT_DIR"]
+
+    # ------------------------------------------------------------------
+    # exactly-once sinks (windflow_tpu.sinks.transactional)
+    # ------------------------------------------------------------------
+    def with_exactly_once(self) -> "PipeGraph":
+        """Graph-wide exactly-once delivery: every sink runs the
+        epoch-fenced two-phase commit (buffer/stage per checkpoint
+        epoch, pre-commit at the aligned barrier, commit atomically on
+        coordinator finalize). Requires ``with_checkpointing``; a sink
+        family that cannot honor the protocol makes ``start()`` refuse
+        loudly rather than silently downgrade the guarantee. Env twin:
+        ``WF_EXACTLY_ONCE=1``."""
+        if self._started:
+            raise WindFlowError("with_exactly_once after start()")
+        self._exactly_once = True
+        return self
+
+    def _negotiate_exactly_once(self) -> None:
+        """Guarantee negotiation (first ``_build``): flip graph-wide
+        exactly-once onto every sink, then verify every exactly-once
+        sink can actually deliver it — loudly, because a guarantee that
+        silently downgrades is worse than a refusal."""
+        sinks = [op for op in self._ops if op.op_type == OpType.SINK]
+        if self._exactly_once:
+            for op in sinks:
+                if not getattr(op, "supports_exactly_once", False):
+                    raise WindFlowError(
+                        f"with_exactly_once: sink {op.name!r} "
+                        f"({type(op).__name__}) does not implement the "
+                        "transactional sink protocol (precommit_epoch / "
+                        "commit-on-finalize); it would deliver "
+                        "at-least-once and break the graph guarantee")
+                op.exactly_once = True
+        eo_sinks = [op for op in sinks
+                    if getattr(op, "exactly_once", False)]
+        for op in eo_sinks:
+            if not getattr(op, "supports_exactly_once", False):
+                raise WindFlowError(
+                    f"sink {op.name!r} ({type(op).__name__}) has "
+                    "exactly_once set but does not implement the "
+                    "transactional sink protocol")
+        if eo_sinks and not self._ckpt_enabled:
+            raise WindFlowError(
+                "exactly-once sinks need the checkpoint plane that "
+                f"drives their commits: sink(s) "
+                f"{[op.name for op in eo_sinks]} request exactly-once "
+                "but checkpointing is off — call with_checkpointing(...) "
+                "(or set WF_CKPT_INTERVAL) before start()")
 
     # ------------------------------------------------------------------
     # checkpointing configuration
@@ -404,6 +457,14 @@ class PipeGraph:
                     "checkpointed topology was fused differently (match "
                     "WF_TPU_FUSION / the chain() calls of the original "
                     "graph)")
+            if "txn_last_epoch" in state \
+                    and not hasattr(replica, "precommit_epoch"):
+                raise WindFlowError(
+                    f"restore: checkpoint blob for {op_name!r} was taken "
+                    "by an exactly-once sink, but this graph runs the "
+                    "sink at-least-once — staged epochs would neither "
+                    "commit nor abort; enable with_exactly_once() to "
+                    "match the checkpointed guarantee")
             state = dict(state)
             em_state = state.pop("__emitter__", None)
             coll_state = state.pop("__collector__", None)
@@ -448,6 +509,12 @@ class PipeGraph:
         if self._built:
             return
         self._built = True
+        # guarantee negotiation BEFORE replica construction (replica
+        # classes are chosen by op.exactly_once) — here rather than in
+        # start() because get_num_threads() builds too, and a build that
+        # silently ignored the requested guarantee would be worse than
+        # the refusal
+        self._negotiate_exactly_once()
         for s in self._stages:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)
@@ -794,6 +861,17 @@ class PipeGraph:
             self._monitor.stop()
             self._monitor.join(timeout=3)
         errors = [w.error for w in self._workers if w.error is not None]
+        if not errors:
+            # exactly-once sinks: the run finished cleanly, so every
+            # still-pending epoch (the post-final-barrier tail, and any
+            # epoch whose finalize landed after its sink worker exited)
+            # commits now, in epoch order, on this thread. On the error
+            # path they stay pending: restore rolls forward/aborts them.
+            for op in self._ops:
+                for r in {id(r): r for r in op.replicas}.values():
+                    fin = getattr(r, "txn_complete", None)
+                    if fin is not None:
+                        fin()
         if errors:
             raise errors[0]
         if env_flag("WF_TRACING_ENABLED"):
