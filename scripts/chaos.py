@@ -45,7 +45,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale")
+SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
+             "supervised_kill")
 
 
 class InjectedCrash(Exception):
@@ -54,21 +55,29 @@ class InjectedCrash(Exception):
 
 class ChaosSource:
     """Replayable seeded source: integers 0..n-1 keyed ``v % nk``;
-    checkpoints at ``ckpt_at`` positions, crash at ``crash_at``, and an
-    optional gate (the rescale scenario pauses mid-stream)."""
+    checkpoints at ``ckpt_at`` positions, crash at ``crash_at``
+    (``crash_times`` kills total — the supervised scenarios crash a
+    bounded number of times, then the replay passes the kill point), and
+    an optional gate (the rescale scenario pauses mid-stream)."""
 
     def __init__(self, n, nk, ckpt_at=(), crash_at=None, gate_at=None,
-                 gate=None):
+                 gate=None, crash_times=None):
         self.n, self.nk = n, nk
         self.ckpt_at = set(ckpt_at)
         self.crash_at = crash_at
         self.gate_at, self.gate = gate_at, gate
+        self.crash_times = crash_times  # None = every pass over crash_at
+        self.crashes = 0
         self.pos = 0
 
     def __call__(self, shipper):
         while self.pos < self.n:
-            if self.crash_at is not None and self.pos == self.crash_at:
-                raise InjectedCrash(f"killed at tuple {self.pos}")
+            if self.crash_at is not None and self.pos == self.crash_at \
+                    and (self.crash_times is None
+                         or self.crashes < self.crash_times):
+                self.crashes += 1
+                raise InjectedCrash(f"killed at tuple {self.pos} "
+                                    f"(crash #{self.crashes})")
             if self.gate_at is not None and self.pos == self.gate_at:
                 self.gate.wait(30)
             v = self.pos
@@ -84,13 +93,17 @@ class ChaosSource:
         self.pos = pos
 
 
-def _build(store, src, txn_dir, results, nk):
+def _build(store, src, txn_dir, results, nk, supervised=False):
     from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph,
                               Sink_Builder, Source_Builder, TimePolicy,
                               WinType)
 
     g = PipeGraph("chaos", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
     g.with_checkpointing(store_dir=store)
+    if supervised:
+        from windflow_tpu import RestartPolicy
+        g.with_supervision(RestartPolicy(max_restarts=8, backoff_s=0.02,
+                                         backoff_max_s=0.2))
     win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
                         key_extractor=lambda t: t["k"], win_len=4,
                         slide_len=4, win_type=WinType.CB, name="kw",
@@ -139,7 +152,10 @@ def _verify(golden, crash_res, rest_res, txn_dir):
 def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
               nk: int = 7) -> dict:
     """One seeded chaos round; returns a report dict with ``ok``."""
-    rng = random.Random((seed << 8) ^ hash(scenario) & 0xFFFF)
+    # zlib.crc32, not hash(): str hashes are salted per process, which
+    # made "same seed" draw different kill points across runs
+    import zlib
+    rng = random.Random((seed << 8) ^ zlib.crc32(scenario.encode()) & 0xFFFF)
     os.makedirs(workdir, exist_ok=True)
     golden = _golden(workdir, n, nk)
     store = os.path.join(workdir, "store")
@@ -213,6 +229,35 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
         if g._coordinator.completed < 1:
             return {**report, "ok": False,
                     "problems": ["rescale checkpoint never committed"]}
+    elif scenario == "supervised_kill":
+        # the availability proof: randomized kill-point with supervision
+        # ON — the graph must recover WITHOUT any manual restore_from,
+        # the exactly-once output must stay byte-identical to an
+        # uninterrupted run, and the measured MTTR is recorded
+        n_ckpts = rng.randint(1, 3)
+        ckpt_at = sorted(rng.sample(range(100, n - 200), n_ckpts))
+        crash_at = rng.randrange(ckpt_at[0] + 1, n)
+        crash_times = rng.randint(1, 2)  # sometimes crash the replay too
+        report.update(ckpt_at=ckpt_at, crash_at=crash_at,
+                      crash_times=crash_times)
+        crash_res = []
+        g = _build(store, ChaosSource(n, nk, ckpt_at, crash_at,
+                                      crash_times=crash_times),
+                   txn, crash_res, nk, supervised=True)
+        g.run()  # recovers in-process; raising here fails the round
+        sup = g.get_stats().get("Supervision", {})
+        problems = []
+        if sup.get("Supervision_restarts", 0) != crash_times:
+            problems.append(
+                f"expected {crash_times} supervised restart(s), saw "
+                f"{sup.get('Supervision_restarts')}")
+        problems += _verify(golden, crash_res, [], txn)
+        report.update(
+            ok=not problems, problems=problems, results=len(golden),
+            restarts=sup.get("Supervision_restarts", 0),
+            mttr_s=sup.get("Supervision_last_restart_s", 0.0),
+            mttr_total_s=sup.get("Supervision_restart_total_s", 0.0))
+        return report
     else:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(choose from {SCENARIOS})")
@@ -230,7 +275,8 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
 def run_sweep(seed: int, rounds: int, scenarios=SCENARIOS,
               workdir=None, n: int = 2000) -> dict:
     """``rounds`` rounds cycling through ``scenarios``, each in a fresh
-    work directory; returns the aggregate report."""
+    work directory; returns the aggregate report (with an MTTR summary
+    when any supervised rounds ran)."""
     base = workdir or tempfile.mkdtemp(prefix="wf_chaos_")
     out = {"seed": seed, "rounds": []}
     try:
@@ -245,6 +291,13 @@ def run_sweep(seed: int, rounds: int, scenarios=SCENARIOS,
         if workdir is None:
             shutil.rmtree(base, ignore_errors=True)
     out["ok"] = all(r["ok"] for r in out["rounds"])
+    mttrs = [r["mttr_s"] for r in out["rounds"] if r.get("mttr_s")]
+    if mttrs:
+        out["mttr"] = {"events": sum(r.get("restarts", 0)
+                                     for r in out["rounds"]),
+                       "last_s": mttrs,
+                       "mean_s": round(sum(mttrs) / len(mttrs), 6),
+                       "max_s": round(max(mttrs), 6)}
     return out
 
 
@@ -256,11 +309,19 @@ def main() -> int:
                     help="tuples per round (default 2000)")
     ap.add_argument("--scenario", choices=SCENARIOS, default=None,
                     help="run only this scenario (default: cycle all)")
+    ap.add_argument("--supervised", action="store_true",
+                    help="randomized kill-points with supervision ON: the "
+                         "graph must recover in-process (no manual "
+                         "restore_from) with byte-identical exactly-once "
+                         "output; records MTTR per round")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (e.g. "
                          "results/chaos.json)")
     args = ap.parse_args()
-    scenarios = (args.scenario,) if args.scenario else SCENARIOS
+    if args.supervised:
+        scenarios = ("supervised_kill",)
+    else:
+        scenarios = (args.scenario,) if args.scenario else SCENARIOS
     report = run_sweep(args.seed, args.rounds, scenarios, n=args.n)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)

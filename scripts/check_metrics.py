@@ -58,6 +58,14 @@ REQUIRED_FAMILIES = (
     "windflow_sink_txn_commits_total",
     "windflow_sink_txn_aborts_total",
     "windflow_sink_txn_fenced_writes_total",
+    # self-healing supervision (the run performs one live supervised
+    # restart: an injected source crash the supervisor recovers from)
+    "windflow_restart_total",
+    "windflow_restart_last_seconds",
+    # dead-letter / error-policy + Kafka retry accounting (per-replica
+    # scalars: present with value 0 on every replica when unused)
+    "windflow_dlq_records_total",
+    "windflow_kafka_reconnects_total",
 )
 
 _SAMPLE_RE = re.compile(
@@ -173,13 +181,23 @@ def run_graph_and_scrape():
 
         gate = threading.Event()
         pos = [0]
+        crashed = [False]
 
         def src(shipper):
             while pos[0] < 20_000:
                 if pos[0] == 10_000:
                     gate.wait(20)
+                if pos[0] == 15_000 and not crashed[0]:
+                    # the supervised-restart leg: the supervisor must
+                    # recover this in-process (windflow_restart_*)
+                    crashed[0] = True
+                    raise RuntimeError("injected crash for check_metrics")
                 shipper.push({"v": pos[0]})
                 pos[0] += 1
+                if pos[0] == 12_000:
+                    # post-rescale checkpoint: the supervised restore
+                    # must target the CURRENT (rescaled) topology
+                    shipper.request_checkpoint()
 
         src.snapshot_position = lambda: pos[0]
         src.restore = lambda p: pos.__setitem__(0, p)
@@ -192,6 +210,9 @@ def run_graph_and_scrape():
         # operator-parallelism families have real samples to validate
         g.with_checkpointing(
             store_dir=tempfile.mkdtemp(prefix="wf_ckpt_"))
+        from windflow_tpu import RestartPolicy
+        g.with_supervision(RestartPolicy(max_restarts=3, backoff_s=0.05,
+                                         backoff_max_s=0.2))
         g.add_source(Source_Builder(src).with_name("src").build()) \
          .add(Map_Builder(lambda t: {"v": t["v"] * 2})
               .with_name("dbl").build()) \
@@ -210,6 +231,9 @@ def run_graph_and_scrape():
         assert rep.changed and rep["pause_s"] > 0, rep
         g.wait_end()
         assert seen[0] == 20_000, f"sink saw {seen[0]} tuples"
+        sup = g.get_stats().get("Supervision", {})
+        assert sup.get("Supervision_restarts") == 1, \
+            f"expected 1 supervised restart, saw {sup}"
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land
         import time

@@ -759,6 +759,166 @@ def bench_flightrec() -> None:
                       "value": best["on"][1], "unit": "events"}))
 
 
+def bench_supervise() -> None:
+    """--supervise: off-path cost of the self-healing plane
+    (windflow_tpu.supervision) on the per-tuple CPU chain. Three
+    interleaved configs, best-of-N:
+
+    - ``base``   — supervision off, FAIL policy: the true default path.
+      The DISABLED machinery adds no per-tuple code to it (a non-FAIL
+      policy shadows ``process`` per instance while FAIL leaves the
+      class method untouched; the channel-close flag is checked on
+      paths that already hold the lock; the worker failure hook is
+      consulted only on the error path) — so this leg IS the measured
+      disabled-path configuration, and the acceptance gate below bounds
+      the machinery's cost from ABOVE with supervision actually on.
+    - ``ckpt``   — with_checkpointing() alone: the prerequisite plane,
+      gated separately by --checkpoint (PR 3); isolates its share.
+    - ``super``  — checkpointing + with_supervision(), zero failures:
+      the supervisor thread polls at 20 Hz, workers carry a hook.
+    - ``policy`` — DEAD_LETTER policy on the map, zero poison records:
+      every tuple runs the guarded wrapper's try/except (the OPT-IN
+      per-record containment cost, informational).
+
+    Acceptance gate: super-vs-ckpt <= 2% (the supervisor's marginal
+    cost); policy-vs-base reported."""
+    import tempfile
+
+    from windflow_tpu import (ExecutionMode, Map_Builder, PipeGraph,
+                              RestartPolicy, Sink_Builder, Source_Builder,
+                              TimePolicy)
+    from windflow_tpu.supervision import ErrorPolicy
+
+    N, REPS = 300_000, 8
+
+    def one_pass(ckpt, supervised, policy):
+        pos = [0]
+
+        def src(shipper):
+            while pos[0] < N:
+                shipper.push({"v": pos[0]})
+                pos[0] += 1
+        src.snapshot_position = lambda: pos[0]
+        src.restore = lambda p: pos.__setitem__(0, p)
+
+        seen = [0]
+        g = PipeGraph("mb_supervise", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        if ckpt or supervised:
+            g.with_checkpointing(
+                store_dir=tempfile.mkdtemp(prefix="wf_mb_sup_"))
+        if supervised:
+            g.with_supervision(RestartPolicy(max_restarts=1))
+        mb = Map_Builder(lambda t: {"v": t["v"] + 1})
+        if policy:
+            mb = mb.with_error_policy(ErrorPolicy.DEAD_LETTER)
+        # CHAINED stages: one worker thread end-to-end (same shape as
+        # --latency/--flightrec, so the delta isolates the new plane's
+        # cost instead of cross-thread scheduling noise)
+        g.add_source(Source_Builder(src).build()) \
+         .chain(mb.build()) \
+         .chain_sink(Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                                  if t else None).build())
+        t0 = time.perf_counter()
+        g.run()
+        return N / (time.perf_counter() - t0)
+
+    configs = (("base", False, False, False),
+               ("ckpt", True, False, False),
+               ("super", True, True, False),
+               ("policy", False, False, True))
+    best = {label: 0.0 for label, _, _, _ in configs}
+    for _ in range(REPS):
+        for label, ck, sup, pol in configs:
+            best[label] = max(best[label], one_pass(ck, sup, pol))
+    for label, _, _, _ in configs:
+        report(f"supervise_{label}", best[label])
+    for label, ref, gate in (
+            ("super", "ckpt",
+             "<=2% vs ckpt (the supervisor's marginal cost; the "
+             "checkpoint prerequisite is gated by --checkpoint)"),
+            ("policy", "base", None)):
+        base = best[ref]
+        pct = 100.0 * (1.0 - best[label] / base) if base else 0.0
+        print(json.dumps({"bench": f"supervise_overhead_pct_{label}",
+                          "value": round(pct, 2), "unit": "pct",
+                          "vs": ref, "acceptance": gate}))
+    print(json.dumps({
+        "bench": "supervise_disabled_path",
+        "note": "machinery disabled (the base leg) adds no per-tuple "
+                "code: FAIL keeps the class process method, the "
+                "channel-close flag rides already-locked paths, the "
+                "worker failure hook is error-path-only"}))
+
+
+def bench_restart() -> None:
+    """--restart: cold-vs-warm restart-to-first-tuple time with the JAX
+    persistent compilation cache (WF_COMPILE_CACHE_DIR /
+    with_compile_cache) — the first rung of the ROADMAP
+    compile-stability item. A device-plane map chain is started three
+    times against ONE cache directory:
+
+    - ``cold``  — empty cache: every chain signature traces AND
+      compiles; the run populates the cache;
+    - ``warm``  — same process, fresh graph: rebuilt replicas create new
+      jit entries, so they re-TRACE, but XLA compilation is served from
+      the persistent cache — exactly the supervised-restart/rescale
+      path;
+    - ``warm2`` — repeat, confirming steady state.
+
+    Reported metric: start() -> first tuple at the sink. Gate: REPORT
+    the ratio (the win scales with program complexity; a trivial program
+    on CPU backends may see little)."""
+    import shutil
+    import tempfile
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu.builders_tpu import Map_TPU_Builder
+
+    cache = tempfile.mkdtemp(prefix="wf_mb_cache_")
+    N, B = 4096, 512
+
+    def one_pass():
+        def src(shipper):
+            for v in range(N):
+                shipper.push({"v": np.int32(v)})
+
+        first = [0.0]
+
+        def sink(t):
+            if t is not None and not first[0]:
+                first[0] = time.perf_counter()
+
+        g = PipeGraph("mb_restart", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_compile_cache(cache)
+        g.add_source(Source_Builder(src)
+                     .with_output_batch_size(B).build()) \
+         .add(Map_TPU_Builder(
+              lambda f: {**f, "v": f["v"] * 3 + 7}).with_name("dm")
+              .build()) \
+         .add_sink(Sink_Builder(sink).build())
+        t0 = time.perf_counter()
+        g.run()
+        return (first[0] - t0) * 1e3 if first[0] else float("nan")
+
+    results = {}
+    for label in ("cold", "warm", "warm2"):
+        results[label] = one_pass()
+        report(f"restart_to_first_tuple_{label}", results[label], "ms")
+    if results["cold"] and results["warm"]:
+        print(json.dumps({"bench": "restart_warm_vs_cold",
+                          "value": round(results["cold"]
+                                         / max(results["warm"], 1e-9), 3),
+                          "unit": "speedup",
+                          "cache_dir": "persistent jax compilation cache",
+                          "note": "warm restarts re-trace but skip XLA "
+                                  "compilation (supervised restart / "
+                                  "rescale path)"}))
+    shutil.rmtree(cache, ignore_errors=True)
+
+
 def bench_cpu_plane() -> None:
     """Per-tuple Python plane: 3-op chain end-to-end (the CPU plane is
     functor-bound by design; the device plane is the throughput story)."""
@@ -850,6 +1010,12 @@ def bench_rescale() -> None:
 
 
 def main() -> None:
+    if "--supervise" in sys.argv[1:]:
+        bench_supervise()
+        return
+    if "--restart" in sys.argv[1:]:
+        bench_restart()
+        return
     if "--rescale" in sys.argv[1:]:
         bench_rescale()
         return
@@ -883,6 +1049,7 @@ def main() -> None:
     bench_flightrec()
     bench_checkpoint()
     bench_txn()
+    bench_supervise()
 
 
 if __name__ == "__main__":

@@ -18,7 +18,12 @@ from common import GlobalSum, TupleT, make_ingress_source, make_sum_sink
 
 def test_failing_replica_unwinds_graph():
     """A user functor raising mid-stream must not deadlock: the graph
-    drains, EOS propagates, wait_end re-raises the original error."""
+    drains, EOS propagates, wait_end re-raises. BOTH map replicas hit
+    value 50, so the error surfaces as the aggregate that names every
+    dead worker (a single dead worker re-raises its error unchanged —
+    test_supervision.py::test_single_error_still_raises_unwrapped)."""
+    from windflow_tpu.basic import WorkerFailuresError
+
     graph = PipeGraph("boom")
     src = (Source_Builder(make_ingress_source(3, 100))
            .with_parallelism(2).build())
@@ -31,8 +36,11 @@ def test_failing_replica_unwinds_graph():
     m = Map_Builder(bad).with_parallelism(2).build()
     graph.add_source(src).add(m).add_sink(
         Sink_Builder(lambda t: None).with_parallelism(2).build())
-    with pytest.raises(ValueError, match="synthetic failure"):
+    with pytest.raises(WorkerFailuresError, match="synthetic failure") as ei:
         graph.run()
+    assert all(isinstance(e, ValueError)
+               for e in ei.value.worker_errors.values())
+    assert "map[0]" in str(ei.value) and "map[1]" in str(ei.value)
 
 
 def test_device_runtime_failure_unwinds_graph():

@@ -1,0 +1,593 @@
+"""Self-healing supervision + per-record error policies
+(windflow_tpu.supervision).
+
+Covers the whole recovery loop tier-1-fast:
+
+- supervised auto-recovery: an injected source crash is healed
+  in-process (no manual ``restore_from``) with exactly-once sink output
+  byte-identical to an uninterrupted run, and cumulative crash counters
+  survive the rebuild;
+- restart-budget escalation: a deterministic crash-loop exhausts the
+  ``RestartPolicy`` budget and ``wait_end`` raises the aggregated error
+  naming the dead worker;
+- ``wait_end`` multi-error aggregation (the old behavior silently
+  discarded every error but ``errors[0]``);
+- error policies: DEAD_LETTER quarantines poison records (with
+  tracebacks) while survivors match a clean run, SKIP drops + counts,
+  RETRY heals transient functor failures and falls back when exhausted;
+- device-path poison isolation: a failing device batch is bisected
+  until the poison record is quarantined alone;
+- Kafka transient-error retry with backoff (fake confluent client);
+- RestartPolicy units: budget window, backoff growth, jitter bounds.
+"""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (ErrorPolicy, ExecutionMode, Map_Builder, PipeGraph,
+                          RestartPolicy, Sink_Builder, Source_Builder,
+                          SupervisionEscalated, TimePolicy, WindFlowError,
+                          WinType)
+from windflow_tpu.basic import WorkerFailuresError
+from windflow_tpu.operators.windows import Keyed_Windows
+
+
+class CrashingSource:
+    """Replayable source: crashes at ``crash_at`` the first
+    ``crash_times`` times the cursor passes it (None = every time)."""
+
+    def __init__(self, n, nk=7, ckpt_at=(), crash_at=None, crash_times=1):
+        self.n, self.nk = n, nk
+        self.ckpt_at = set(ckpt_at)
+        self.crash_at, self.crash_times = crash_at, crash_times
+        self.crashes = 0
+        self.pos = 0
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.crash_at is not None and self.pos == self.crash_at \
+                    and (self.crash_times is None
+                         or self.crashes < self.crash_times):
+                self.crashes += 1
+                raise ValueError(f"injected crash #{self.crashes}")
+            v = self.pos
+            shipper.push({"k": v % self.nk, "v": v})
+            self.pos += 1
+            if self.pos in self.ckpt_at:
+                shipper.request_checkpoint()
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+def _build_windows_graph(tmp, src, results, supervised=True,
+                         policy=None, exactly_once=True):
+    g = PipeGraph("t_sup", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp / "store"))
+    if supervised:
+        g.with_supervision(policy or RestartPolicy(
+            max_restarts=4, backoff_s=0.02, backoff_max_s=0.1))
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results.append((t.key, t.wid, t.value))
+
+    snk = Sink_Builder(sink).with_name("snk")
+    if exactly_once:
+        snk = snk.with_exactly_once(staging_dir=str(tmp / "txn"))
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(win).add_sink(snk.build())
+    return g
+
+
+# ---------------------------------------------------------------------------
+# supervised auto-recovery
+# ---------------------------------------------------------------------------
+def test_supervised_auto_recovery_exactly_once(tmp_path):
+    golden = []
+    _build_windows_graph(tmp_path / "gold", CrashingSource(1500, crash_at=None),
+                         golden, supervised=False).run()
+
+    results = []
+    g = _build_windows_graph(
+        tmp_path / "run",
+        CrashingSource(1500, ckpt_at=[400], crash_at=900), results)
+    g.run()  # no exception, no manual restore_from
+    assert sorted(results) == sorted(golden)
+    st = g.get_stats()
+    sup = st["Supervision"]
+    assert sup["Supervision_restarts"] == 1
+    assert sup["Supervision_last_restart_s"] > 0  # the measured MTTR
+    assert not sup["Supervision_escalated"]
+    # cumulative crash counters carried across the rebuild: the source
+    # replica's crash is still visible after recovery
+    src_op = next(o for o in st["Operators"] if o["name"] == "src")
+    assert src_op["replicas"][0]["Worker_crashes"] >= 1
+    assert "ValueError" in src_op["replicas"][0]["Worker_last_error"]
+
+
+def test_supervised_recovery_double_crash(tmp_path):
+    """The replay crashes again at the same point: two restarts, still
+    byte-identical output."""
+    golden = []
+    _build_windows_graph(tmp_path / "gold", CrashingSource(1200),
+                         golden, supervised=False).run()
+    results = []
+    g = _build_windows_graph(
+        tmp_path / "run",
+        CrashingSource(1200, ckpt_at=[300], crash_at=700, crash_times=2),
+        results)
+    g.run()
+    assert sorted(results) == sorted(golden)
+    assert g.get_stats()["Supervision"]["Supervision_restarts"] == 2
+
+
+def test_supervise_env_knob_and_flight_spans(tmp_path, monkeypatch):
+    """WF_SUPERVISE=1 arms supervision without code changes, and the
+    recovery leaves a ``supervise:*`` span trail in the flight rings."""
+    monkeypatch.setenv("WF_SUPERVISE", "1")
+    monkeypatch.setenv("WF_SUPERVISE_BACKOFF_S", "0.02")
+    monkeypatch.setenv("WF_SUPERVISE_BACKOFF_MAX_S", "0.05")
+    monkeypatch.setenv("WF_CKPT_DIR", str(tmp_path / "store"))
+    results = []
+    src = CrashingSource(600, ckpt_at=[200], crash_at=400)
+    g = PipeGraph("t_env", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_flight_recorder(256)
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: t["k"], win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(win) \
+        .add_sink(Sink_Builder(
+            lambda t: results.append(t.value) if t is not None else None)
+            .build())
+    g.run()
+    assert g._supervisor is not None  # armed purely via the env knob
+    assert g.get_stats()["Supervision"]["Supervision_restarts"] == 1
+    names = {e["name"] for e in g.trace_document()["traceEvents"]}
+    for span in ("supervise:failure", "supervise:backoff",
+                 "supervise:teardown", "supervise:restore",
+                 "supervise:resume"):
+        assert span in names, (span, sorted(names))
+
+
+def test_supervised_recovery_before_first_checkpoint(tmp_path):
+    """A crash BEFORE any checkpoint has committed must not silently
+    drop the prefix that sat in the discarded channels: the supervisor
+    resets replayable sources to their INITIAL positions (full replay)
+    and the exactly-once sink keeps the output byte-identical."""
+    golden = []
+    _build_windows_graph(tmp_path / "gold", CrashingSource(1000),
+                         golden, supervised=False).run()
+    results = []
+    g = _build_windows_graph(
+        tmp_path / "run",
+        CrashingSource(1000, ckpt_at=[], crash_at=600), results)
+    g.run()
+    assert sorted(results) == sorted(golden)
+    assert g.get_stats()["Supervision"]["Supervision_restarts"] == 1
+
+
+def test_supervised_recovery_aborts_stale_precommitted_epoch(tmp_path,
+                                                            monkeypatch):
+    """The deadliest interleaving: the sink PRE-COMMITTED an epoch but
+    the coordinator's store commit dies, so the crash leaves a staged
+    ``.pending`` segment with NO committed checkpoint. The supervisor's
+    full-replay recovery must ABORT that stale epoch — rolling it
+    forward on a later checkpointed restore would duplicate its records
+    (the double-crash chaos differential caught this)."""
+    from windflow_tpu.checkpoint.store import CheckpointStore
+
+    golden = []
+    _build_windows_graph(tmp_path / "gold", CrashingSource(1200),
+                         golden, supervised=False).run()
+
+    orig = CheckpointStore.commit
+    armed = [True]
+
+    def dying_commit(self, ckpt_id, manifest):
+        if armed[0]:
+            armed[0] = False
+            raise RuntimeError("store commit dies after sink precommit")
+        return orig(self, ckpt_id, manifest)
+
+    monkeypatch.setattr(CheckpointStore, "commit", dying_commit)
+    results = []
+    g = _build_windows_graph(
+        tmp_path / "run",
+        # a second checkpoint + a later crash exercise the checkpointed
+        # restore AFTER the no-checkpoint recovery (the roll-forward
+        # window the stale pending epoch would poison)
+        CrashingSource(1200, ckpt_at=[300, 600], crash_at=800), results)
+    g.run()
+    assert sorted(results) == sorted(golden)
+    assert g.get_stats()["Supervision"]["Supervision_restarts"] >= 1
+
+
+def test_restart_budget_escalation(tmp_path):
+    """A deterministic crash-loop exhausts the budget; the aggregated
+    error names the dead worker and carries the original exception."""
+    g = _build_windows_graph(
+        tmp_path, CrashingSource(500, crash_at=100, crash_times=None),
+        [], policy=RestartPolicy(max_restarts=2, backoff_s=0.01,
+                                 backoff_max_s=0.02),
+        exactly_once=False)
+    with pytest.raises(SupervisionEscalated) as ei:
+        g.run()
+    msg = str(ei.value)
+    assert "gave up after 2 restart" in msg
+    assert "src" in msg and "ValueError" in msg
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert g._supervisor.restarts == 2
+
+
+def test_wait_end_aggregates_multiple_errors():
+    """Two independent source crashes: wait_end names BOTH dead workers
+    instead of silently discarding all but errors[0]."""
+    def boom_a(shipper):
+        raise ValueError("boom-a")
+
+    def boom_b(shipper):
+        time.sleep(0.05)
+        raise KeyError("boom-b")
+
+    seen = []
+    g = PipeGraph("t_multi", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(boom_a).with_name("sa").build()) \
+        .add_sink(Sink_Builder(lambda t: seen.append(t) if t else None)
+                  .with_name("ka").build())
+    g.add_source(Source_Builder(boom_b).with_name("sb").build()) \
+        .add_sink(Sink_Builder(lambda t: seen.append(t) if t else None)
+                  .with_name("kb").build())
+    with pytest.raises(WorkerFailuresError) as ei:
+        g.run()
+    msg = str(ei.value)
+    assert "sa" in msg and "sb" in msg
+    assert "ValueError" in msg and "KeyError" in msg
+    assert len(ei.value.worker_errors) == 2
+
+
+def test_single_error_still_raises_unwrapped():
+    """One dead worker: the original exception type propagates unchanged
+    (backward compatibility with every existing crash-injection test)."""
+    def boom(shipper):
+        raise OSError("solo")
+
+    g = PipeGraph("t_solo", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(boom).build()) \
+        .add_sink(Sink_Builder(lambda t: None).build())
+    with pytest.raises(OSError, match="solo"):
+        g.run()
+
+
+# ---------------------------------------------------------------------------
+# per-record error policies
+# ---------------------------------------------------------------------------
+def _poison_map(t):
+    if t["v"] % 97 == 13:
+        raise ValueError(f"poison {t['v']}")
+    return {"v": t["v"] * 2}
+
+
+def _run_policy_graph(policy, n=800):
+    seen = []
+
+    def src(shipper):
+        for v in range(n):
+            shipper.push({"v": v})
+
+    g = PipeGraph("t_pol", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    mb = Map_Builder(_poison_map).with_name("pm")
+    if policy is not None:
+        mb = mb.with_error_policy(policy)
+    g.add_source(Source_Builder(src).build()) \
+        .add(mb.build()) \
+        .add_sink(Sink_Builder(lambda t: seen.append(t["v"]) if t else None)
+                  .build())
+    g.run()
+    return g, seen
+
+
+def test_dead_letter_differential():
+    """Poison records land in the DLQ with tracebacks; survivors match a
+    clean run minus the poison — the graph keeps running."""
+    expected = [v * 2 for v in range(800) if v % 97 != 13]
+    poisons = [v for v in range(800) if v % 97 == 13]
+    g, seen = _run_policy_graph(ErrorPolicy.DEAD_LETTER)
+    assert seen == expected
+    dl = g.dead_letters()
+    assert len(dl) == len(poisons)
+    for rec, v in zip(dl, poisons):
+        assert rec["operator"] == "pm"
+        assert f"poison {v}" in rec["error"]
+        assert "ValueError" in rec["traceback"]
+        assert rec["payload_obj"] == {"v": v}
+    st = g.get_stats()
+    pm = next(o for o in st["Operators"] if o["name"] == "pm")
+    assert pm["replicas"][0]["Dlq_records"] == len(poisons)
+    assert st["Dead_letters"] == len(poisons)
+
+
+def test_skip_policy():
+    expected = [v * 2 for v in range(800) if v % 97 != 13]
+    g, seen = _run_policy_graph(ErrorPolicy.SKIP)
+    assert seen == expected
+    pm = next(o for o in g.get_stats()["Operators"] if o["name"] == "pm")
+    assert pm["replicas"][0]["Dlq_skipped"] == \
+        len([v for v in range(800) if v % 97 == 13])
+    assert g.dead_letters() == []  # SKIP never quarantines
+
+
+def test_fail_policy_unchanged():
+    with pytest.raises(ValueError, match="poison 13"):
+        _run_policy_graph(None)
+
+
+def test_retry_policy_heals_transient():
+    failures = {}
+
+    def flaky(t):
+        if t["v"] in (7, 31) and failures.setdefault(t["v"], 0) < 2:
+            failures[t["v"]] += 1
+            raise OSError("transient")
+        return t
+
+    seen = []
+    g = PipeGraph("t_retry", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(
+        lambda s: [s.push({"v": v}) for v in range(50)]).build()) \
+        .add(Map_Builder(flaky).with_name("fm")
+             .with_error_policy(ErrorPolicy.RETRY(3, backoff_s=0.001))
+             .build()) \
+        .add_sink(Sink_Builder(lambda t: seen.append(t["v"]) if t else None)
+                  .build())
+    g.run()
+    assert seen == list(range(50))  # every record healed, order intact
+    fm = next(o for o in g.get_stats()["Operators"] if o["name"] == "fm")
+    assert fm["replicas"][0]["Dlq_retries"] == 4  # 2 records x 2 attempts
+
+
+def test_retry_exhausted_falls_back_to_dead_letter():
+    g, seen = _run_policy_graph(
+        ErrorPolicy.RETRY(2, backoff_s=0.0, on_exhausted="dead_letter"),
+        n=200)
+    poisons = [v for v in range(200) if v % 97 == 13]
+    assert seen == [v * 2 for v in range(200) if v % 97 != 13]
+    assert len(g.dead_letters()) == len(poisons)
+    pm = next(o for o in g.get_stats()["Operators"] if o["name"] == "pm")
+    assert pm["replicas"][0]["Dlq_retries"] == 2 * len(poisons)
+
+
+def test_error_policy_refused_on_sources():
+    g = PipeGraph("t_ref", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(lambda s: None)
+                 .with_error_policy(ErrorPolicy.SKIP).build()) \
+        .add_sink(Sink_Builder(lambda t: None).build())
+    with pytest.raises(WindFlowError, match="generation loop"):
+        g.run()
+
+
+def test_error_policy_parse():
+    assert ErrorPolicy.parse("skip").kind == "skip"
+    assert ErrorPolicy.parse("dead_letter").kind == "dead_letter"
+    p = ErrorPolicy.parse("retry:3")
+    assert p.kind == "retry" and p.retries == 3
+    with pytest.raises(WindFlowError):
+        ErrorPolicy.parse("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# device-path poison isolation (batch bisection)
+# ---------------------------------------------------------------------------
+def test_device_batch_bisection_isolates_poison():
+    from windflow_tpu.supervision.errors import ErrorPolicy as EP
+    from windflow_tpu.tpu.builders_tpu import Map_TPU_Builder
+    from windflow_tpu.tpu.ops_tpu import MapTPUReplica
+
+    orig = MapTPUReplica.prep_device_batch
+
+    def poisoned(self, batch):
+        vals = np.asarray(batch.fields["v"])[:batch.size]
+        if (vals == 666).any():
+            raise ValueError("poison column value 666")
+        return orig(self, batch)
+
+    MapTPUReplica.prep_device_batch = poisoned
+    try:
+        out = []
+
+        def src(shipper):
+            for v in range(256):
+                shipper.push({"v": np.int32(v if v != 100 else 666)})
+
+        g = PipeGraph("t_dev", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.add_source(Source_Builder(src).with_output_batch_size(64)
+                     .build()) \
+            .add(Map_TPU_Builder(lambda f: {**f, "v": f["v"] + 1})
+                 .with_name("dm").with_error_policy(EP.DEAD_LETTER)
+                 .build()) \
+            .add_sink(Sink_Builder(
+                lambda t: out.append(t["v"]) if t is not None else None)
+                .build())
+        g.run()
+    finally:
+        MapTPUReplica.prep_device_batch = orig
+    dl = g.dead_letters()
+    assert len(dl) == 1  # exactly the poison record, nothing else
+    assert dl[0]["payload_obj"] == {"v": 666}
+    assert sorted(out) == sorted(v + 1 for v in range(256) if v != 100)
+
+
+def test_error_policy_refuses_device_fusion():
+    """A device op carrying an error policy keeps its own stage (one
+    fused program cannot attribute a failure to a sub-op)."""
+    from windflow_tpu.tpu.builders_tpu import Map_TPU_Builder
+
+    g = PipeGraph("t_fuse", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(
+        lambda s: [s.push({"v": np.int32(v)}) for v in range(64)])
+        .with_output_batch_size(32).build()) \
+        .chain(Map_TPU_Builder(lambda f: {**f, "v": f["v"] + 1})
+               .with_name("m1").build()) \
+        .chain(Map_TPU_Builder(lambda f: {**f, "v": f["v"] * 2})
+               .with_name("m2")
+               .with_error_policy(ErrorPolicy.DEAD_LETTER).build()) \
+        .add_sink(Sink_Builder(lambda t: None).build())
+    stages = {s.describe(): s for s in g._stages}
+    assert not any("m1∘m2" in d or "m1∘" in d and "m2" in d
+                   for d in stages)  # m2 refused fusion
+    m2_stage = next(s for s in g._stages
+                    if any(o.name == "m2" for o in s.ops))
+    assert m2_stage.chain_refused is not None
+    assert "error policy" in m2_stage.chain_refused
+
+
+# ---------------------------------------------------------------------------
+# Kafka transient-error retry
+# ---------------------------------------------------------------------------
+def _fake_confluent_flaky(fail_polls):
+    """Minimal confluent_kafka fake whose consumer poll raises
+    KafkaException ``fail_polls`` times before succeeding (returning no
+    message)."""
+    mod = types.ModuleType("confluent_kafka_fake")
+
+    class KafkaException(Exception):
+        pass
+
+    state = {"fails": fail_polls, "polls": 0}
+
+    class Consumer:
+        def __init__(self, conf):
+            self.conf = conf
+
+        def subscribe(self, topics):
+            pass
+
+        def poll(self, timeout):
+            state["polls"] += 1
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise KafkaException("broker hiccup")
+            return None
+
+        def close(self):
+            pass
+
+    mod.KafkaException = KafkaException
+    mod.Consumer = Consumer
+    mod._state = state
+    return mod
+
+
+def test_kafka_consume_retries_transient_errors(monkeypatch):
+    from windflow_tpu.kafka.connectors import ConfluentTransport
+
+    monkeypatch.setenv("WF_KAFKA_RETRIES", "5")
+    monkeypatch.setenv("WF_KAFKA_RETRY_BASE_MS", "1")
+    mod = _fake_confluent_flaky(fail_polls=3)
+    t = ConfluentTransport("broker:9092", module=mod)
+    retries = []
+    t.on_retry = lambda: retries.append(1)
+    assert t.subscribe(["topic"], "g", 0, 1, {})
+    assert t.consume() is None  # healed after 3 transient failures
+    assert len(retries) == 3
+
+
+def test_kafka_retry_exhaustion_propagates(monkeypatch):
+    from windflow_tpu.kafka.connectors import ConfluentTransport
+
+    monkeypatch.setenv("WF_KAFKA_RETRIES", "2")
+    monkeypatch.setenv("WF_KAFKA_RETRY_BASE_MS", "1")
+    mod = _fake_confluent_flaky(fail_polls=99)
+    t = ConfluentTransport("broker:9092", module=mod)
+    assert t.subscribe(["topic"], "g", 0, 1, {})
+    with pytest.raises(WindFlowError, match="still failing after 2"):
+        t.consume()
+
+
+def test_kafka_retry_heals_then_delivers(monkeypatch):
+    """A transport whose consume hiccups transiently heals through
+    ``_retrying`` and still delivers the message; every retry invokes
+    the ``on_retry`` hook the replicas count as Kafka_reconnects."""
+    from windflow_tpu.kafka import connectors as conn
+
+    monkeypatch.setenv("WF_KAFKA_RETRIES", "5")
+    monkeypatch.setenv("WF_KAFKA_RETRY_BASE_MS", "1")
+    conn.MemoryBroker.reset()
+    broker = conn.MemoryBroker.get("retrytest")
+    for i in range(20):
+        broker.produce("t", i, partition=0)
+
+    flaky = {"n": 2}
+    orig_consume = conn.MemoryTransport.consume
+
+    class Hiccup(Exception):
+        pass
+
+    def flaky_consume(self):
+        if flaky["n"] > 0:
+            flaky["n"] -= 1
+            raise Hiccup("transient")
+        return orig_consume(self)
+
+    monkeypatch.setattr(conn.MemoryTransport, "consume", flaky_consume)
+    monkeypatch.setattr(conn.MemoryTransport, "_transient_excs",
+                        lambda self: (Hiccup,))
+    t = conn.MemoryTransport("retrytest")
+    retries = []
+    t.on_retry = lambda: retries.append(1)
+    t.subscribe(["t"], "g", 0, 1, {})
+    got = conn._retrying(t, lambda: t.consume(), "consume")
+    assert got is not None and got.payload == 0
+    assert len(retries) == 2
+
+
+# ---------------------------------------------------------------------------
+# RestartPolicy units
+# ---------------------------------------------------------------------------
+def test_restart_policy_budget_window():
+    p = RestartPolicy(max_restarts=2, window_s=1000.0, seed=1)
+    now = 0.0
+    assert p.allow_restart(now)
+    p.note_restart(now)
+    p.note_restart(now)
+    assert not p.allow_restart(now)  # budget exhausted
+    # outside the window the budget refreshes
+    assert p.allow_restart(now + 1001.0)
+
+
+def test_restart_policy_backoff_growth_and_jitter():
+    p = RestartPolicy(max_restarts=10, window_s=1e9, backoff_s=1.0,
+                      backoff_max_s=8.0, backoff_factor=2.0, jitter=0.5,
+                      seed=42)
+    now = 0.0
+    seen = []
+    for _ in range(6):
+        d = p.next_backoff(now)
+        seen.append(d)
+        p.note_restart(now)
+    # k-th backoff is jittered in [0.5, 1.0] * min(2**k, 8)
+    for k, d in enumerate(seen):
+        base = min(2.0 ** k, 8.0)
+        assert base * 0.5 <= d <= base, (k, d)
+    assert seen[3] > seen[0]  # genuinely grows
+
+
+def test_restart_policy_env(monkeypatch):
+    monkeypatch.setenv("WF_SUPERVISE_MAX_RESTARTS", "7")
+    monkeypatch.setenv("WF_SUPERVISE_BACKOFF_S", "0.25")
+    p = RestartPolicy.from_env()
+    assert p.max_restarts == 7
+    assert p.backoff_s == 0.25

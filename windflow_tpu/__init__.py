@@ -37,6 +37,8 @@ from .operators.windows import (Keyed_Windows, MapReduce_Windows,
 from .operators.source import Source, SourceShipper
 from .scaling.autoscaler import AutoscalePolicy
 from .sinks.transactional import FencedWriteError
+from .supervision import (DeadLetterQueue, ErrorPolicy, RestartPolicy,
+                          SupervisionEscalated)
 from .topology.multipipe import MultiPipe
 from .topology.pipegraph import PipeGraph
 
@@ -58,5 +60,7 @@ __all__ = [
     "Paned_Windows_Builder", "MapReduce_Windows_Builder",
     "Ffat_Windows_Builder", "Interval_Join", "Interval_Join_Builder",
     "AutoscalePolicy",
+    "RestartPolicy", "ErrorPolicy", "DeadLetterQueue",
+    "SupervisionEscalated",
     "__version__",
 ]

@@ -127,6 +127,31 @@ class RescaleTeardown(BaseException):
     ``Worker.run`` catches it explicitly and exits silently."""
 
 
+class SupervisorTeardown(RescaleTeardown):
+    """Supervised-recovery twin of ``RescaleTeardown``
+    (``windflow_tpu.supervision``): raised out of a CLOSED channel's
+    put/get so every worker of a dying runtime plane — sources blocked
+    mid-push included — unwinds promptly without an EOS cascade while
+    the supervisor rebuilds and restores from the latest committed
+    checkpoint. Subclasses RescaleTeardown so the worker's silent-exit
+    path handles both."""
+
+
+class WorkerFailuresError(WindFlowError):
+    """Aggregate of SEVERAL workers' errors (``PipeGraph.wait_end``): a
+    single dead worker re-raises its own exception unchanged, but when
+    multiple workers died the message names every one of them instead of
+    silently discarding all but ``errors[0]``. ``worker_errors`` maps
+    worker name -> exception; ``__cause__`` is the first error."""
+
+    def __init__(self, worker_errors) -> None:
+        self.worker_errors = dict(worker_errors)
+        parts = [f"{name} ({type(e).__name__}: {e})"
+                 for name, e in self.worker_errors.items()]
+        super().__init__(
+            f"{len(self.worker_errors)} workers died: " + "; ".join(parts))
+
+
 def as_key_fn(key):
     """Normalize a key extractor: callables pass through; a string names a
     tuple field (works for dataclass attributes and dict keys). String keys

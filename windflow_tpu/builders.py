@@ -38,6 +38,7 @@ class BasicBuilder:
         self._closing: Optional[Callable] = None
         self._latency_sample: Optional[int] = None
         self._flightrec_events: Optional[int] = None
+        self._error_policy = None
 
     def with_name(self, name: str) -> "BasicBuilder":
         self._name = name
@@ -85,12 +86,37 @@ class BasicBuilder:
                                   or DEFAULT_EVENTS)
         return self
 
+    def with_error_policy(self, policy) -> "BasicBuilder":
+        """Per-record failure containment
+        (``windflow_tpu.supervision.errors``): ``policy`` is an
+        ``ErrorPolicy`` — ``FAIL`` (default: a functor exception kills
+        the worker, the pre-existing behavior), ``SKIP`` (drop + count),
+        ``RETRY(n, backoff_s=...)`` (re-invoke with exponential backoff,
+        then the ``on_exhausted`` fallback), or ``DEAD_LETTER``
+        (quarantine record + exception metadata into the graph's
+        dead-letter queue, surfaced as ``Dlq_*`` stats /
+        ``windflow_dlq_records_total``). On device operators a failing
+        batch is bisected until the poison record is isolated. A string
+        is parsed like the ``WF_ERROR_POLICY`` env knob
+        (``"skip"`` / ``"dead_letter"`` / ``"retry:3"``)."""
+        from .supervision.errors import ErrorPolicy
+        if isinstance(policy, str):
+            policy = ErrorPolicy.parse(policy)
+        if not isinstance(policy, ErrorPolicy):
+            raise WindFlowError(
+                f"with_error_policy: expected an ErrorPolicy (or a spec "
+                f"string), got {type(policy).__name__}")
+        self._error_policy = policy
+        return self
+
     def _finish(self, op):
         op.closing_func = self._closing
         if self._latency_sample is not None:
             op.latency_sample = self._latency_sample
         if self._flightrec_events is not None:
             op.flightrec_events = self._flightrec_events
+        if self._error_policy is not None:
+            op.error_policy = self._error_policy
         return op
 
 

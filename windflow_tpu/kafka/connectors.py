@@ -20,6 +20,8 @@ small interface (subscribe/consume/produce/flush/close):
 
 from __future__ import annotations
 
+import os
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -28,6 +30,62 @@ from ..basic import OpType, RoutingMode, WindFlowError, current_time_usecs
 from ..operators.base import BasicOperator, BasicReplica, arity
 from ..operators.source import SourceShipper
 from ..sinks.transactional import FencedWriteError
+
+
+# ---------------------------------------------------------------------------
+# transient-error retry (jittered exponential backoff): a broker hiccup
+# must not surface as a worker crash — bounded attempts with backoff, a
+# Kafka_reconnects stat per retry, THEN the error propagates to the
+# supervisor/wait_end like any other failure
+# ---------------------------------------------------------------------------
+def _kafka_retry_attempts() -> int:
+    try:
+        return max(0, int(os.environ.get("WF_KAFKA_RETRIES", "5")))
+    except ValueError:
+        return 5  # malformed knob must not take down the graph
+
+
+def _kafka_retry_base_s() -> float:
+    try:
+        return max(0.0,
+                   float(os.environ.get("WF_KAFKA_RETRY_BASE_MS", "100"))
+                   / 1e3)
+    except ValueError:
+        return 0.1
+
+
+def _retrying(transport, fn: Callable, what: str):
+    """Run ``fn`` with bounded retry on the transport's transient error
+    classes: the k-th retry sleeps ``base * 2**k`` seconds with uniform
+    jitter in [0.5, 1.0] of that value (a replica fleet must not retry a
+    flapping broker in lockstep). Every retry invokes
+    ``transport.on_retry`` (the replica counts it as Kafka_reconnects);
+    exhausted attempts re-raise the last error."""
+    transients = transport._transient_excs()
+    if not transients:
+        return fn()
+    attempts = _kafka_retry_attempts()
+    base = _kafka_retry_base_s()
+    for attempt in range(attempts + 1):
+        try:
+            return fn()
+        except transients as e:
+            # confluent wraps a KafkaError carrying .fatal() in args[0]:
+            # authentication/config errors never heal by retry
+            inner = e.args[0] if getattr(e, "args", None) else None
+            fatal = getattr(inner, "fatal", None)
+            if callable(fatal) and fatal():
+                raise
+            if attempt >= attempts:
+                raise WindFlowError(
+                    f"Kafka {what}: still failing after {attempts} "
+                    f"retr{'y' if attempts == 1 else 'ies'}: "
+                    f"{type(e).__name__}: {e}") from e
+            cb = getattr(transport, "on_retry", None)
+            if cb is not None:
+                cb()
+            delay = base * (2 ** attempt)
+            time.sleep(delay * (0.5 + 0.5 * random.random()))
 
 
 class KafkaMessage:
@@ -222,6 +280,10 @@ class MemoryTransport:
         self._pos: Dict[Tuple[str, int], int] = {}
         self._rr = 0
         self._group = "windflow"
+        self.on_retry = None  # in-process broker: no transient failures
+
+    def _transient_excs(self) -> tuple:
+        return ()
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
         self._group = group
@@ -303,15 +365,26 @@ class ConfluentTransport:
         # the coordinator finalizes a checkpoint (at-least-once end to
         # end); KafkaSourceReplica flips this before subscribe
         self.auto_commit = True
+        # transient-error retry: the owning replica wires this to its
+        # Kafka_reconnects counter
+        self.on_retry = None
+
+    def _transient_excs(self) -> tuple:
+        exc = getattr(self._ck, "KafkaException", None)
+        return (exc,) if isinstance(exc, type) else ()
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
         ck = self._ck
-        self._consumer = ck.Consumer({
-            "bootstrap.servers": self.brokers,
-            "group.id": group,
-            "enable.auto.commit": self.auto_commit,
-            "auto.offset.reset": "earliest",
-        })
+
+        def _connect():
+            return ck.Consumer({
+                "bootstrap.servers": self.brokers,
+                "group.id": group,
+                "enable.auto.commit": self.auto_commit,
+                "auto.offset.reset": "earliest",
+            })
+
+        self._consumer = _retrying(self, _connect, "consumer connect")
         if offsets:
             # explicit offsets = explicit assignment (reference
             # kafka_source.hpp manual-offset mode): the listed partitions
@@ -327,7 +400,7 @@ class ConfluentTransport:
         return True
 
     def consume(self) -> Optional[KafkaMessage]:
-        msg = self._consumer.poll(0.01)
+        msg = _retrying(self, lambda: self._consumer.poll(0.01), "consume")
         if msg is None:
             return None
         err = msg.error()
@@ -359,9 +432,13 @@ class ConfluentTransport:
         if key is not None:
             kwargs["key"] = key
         p = self._ensure_producer()
+
+        def _produce_once():
+            p.produce(topic, value=payload, **kwargs)
+
         for attempt in range(60):
             try:
-                p.produce(topic, value=payload, **kwargs)
+                _retrying(self, _produce_once, "produce")
                 break
             except BufferError:
                 # local librdkafka queue full: backpressure, don't crash
@@ -461,13 +538,22 @@ class KafkaPythonTransport:
         self._consumer = None
         self._producer = None
         self.auto_commit = True  # see ConfluentTransport
+        self.on_retry = None
+
+    def _transient_excs(self) -> tuple:
+        exc = getattr(getattr(self._kp, "errors", None), "KafkaError", None)
+        return (exc,) if isinstance(exc, type) else ()
 
     def subscribe(self, topics, group, member, n_members, offsets) -> bool:
         kp = self._kp
-        self._consumer = kp.KafkaConsumer(
-            bootstrap_servers=self.brokers, group_id=group,
-            enable_auto_commit=self.auto_commit,
-            auto_offset_reset="earliest")
+
+        def _connect():
+            return kp.KafkaConsumer(
+                bootstrap_servers=self.brokers, group_id=group,
+                enable_auto_commit=self.auto_commit,
+                auto_offset_reset="earliest")
+
+        self._consumer = _retrying(self, _connect, "consumer connect")
         if offsets:
             mine = _member_share(offsets, member, n_members)
             if not mine:
@@ -481,7 +567,9 @@ class KafkaPythonTransport:
         return True
 
     def consume(self) -> Optional[KafkaMessage]:
-        polled = self._consumer.poll(timeout_ms=10, max_records=1)
+        polled = _retrying(
+            self, lambda: self._consumer.poll(timeout_ms=10, max_records=1),
+            "consume")
         for _tp, records in polled.items():
             for r in records:
                 ts_us = (r.timestamp * 1000 if getattr(r, "timestamp", 0)
@@ -497,8 +585,10 @@ class KafkaPythonTransport:
         return self._producer
 
     def produce(self, topic, payload, partition=None, key=None) -> None:
-        self._ensure_producer().send(topic, value=payload,
-                                     partition=partition, key=key)
+        p = self._ensure_producer()
+        _retrying(self, lambda: p.send(topic, value=payload,
+                                       partition=partition, key=key),
+                  "produce")
 
     def flush(self) -> None:
         if self._producer is not None:
@@ -598,6 +688,11 @@ class KafkaSourceReplica(BasicReplica):
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Kafka_Source has no input")
 
+    def _note_reconnect(self) -> None:
+        """Transport retry hook: one transient-error retry/reconnect
+        (``Kafka_reconnects`` / ``windflow_kafka_reconnects_total``)."""
+        self.stats.kafka_reconnects += 1
+
     # -- checkpointing -----------------------------------------------------
     def bind_checkpoint(self, coordinator, inject_cb) -> None:
         self._coord = coordinator
@@ -663,6 +758,7 @@ class KafkaSourceReplica(BasicReplica):
         transport = make_transport(op.brokers)
         if self._coord is not None and hasattr(transport, "auto_commit"):
             transport.auto_commit = False  # commits ride checkpoints only
+        transport.on_retry = self._note_reconnect
         self._transport = transport
         offsets = op.offsets
         if self._restore_offsets is not None:
@@ -761,8 +857,12 @@ class KafkaSinkReplica(BasicReplica):
     def __init__(self, op, idx):
         super().__init__(op, idx)
         self._transport = make_transport(op.brokers)
+        self._transport.on_retry = self._note_reconnect
         # terminal operator: record end-to-end latency of traced tuples
         self._e2e = self.stats.hist_e2e
+
+    def _note_reconnect(self) -> None:
+        self.stats.kafka_reconnects += 1
 
     def process(self, payload, ts, wm, tag):
         out = (self.op.ser_func(payload, self.context) if self.op._riched

@@ -330,8 +330,10 @@ class StallWatchdog(threading.Thread):
             self._check(time.monotonic())
 
     def _check(self, now: float) -> None:
-        if getattr(self.graph, "_rescaling", False):
-            # a rescale parks every worker at the barrier on purpose;
+        if getattr(self.graph, "_rescaling", False) \
+                or getattr(self.graph, "_supervising", False):
+            # a rescale parks every worker at the barrier on purpose (and
+            # a supervised recovery tears the plane down mid-flight);
             # re-arm from scratch once the new plane is running
             self._seen.clear()
             return

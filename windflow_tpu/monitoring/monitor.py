@@ -183,6 +183,17 @@ _PROM_SCALARS = (
     ("windflow_worker_crashes_total", "counter",
      "Worker threads that died on an unhandled exception",
      "Worker_crashes", 1),
+    ("windflow_dlq_records_total", "counter",
+     "Poison records quarantined to the dead-letter queue "
+     "(DEAD_LETTER error policy)", "Dlq_records", 1),
+    ("windflow_dlq_skipped_total", "counter",
+     "Records dropped by a SKIP error policy", "Dlq_skipped", 1),
+    ("windflow_dlq_retries_total", "counter",
+     "Record-level retry attempts under a RETRY error policy",
+     "Dlq_retries", 1),
+    ("windflow_kafka_reconnects_total", "counter",
+     "Kafka transient-error retries/reconnects (connect/produce/consume)",
+     "Kafka_reconnects", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -300,6 +311,34 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             block = st.get("Rescales") if field.startswith("Rescale") \
                 else st.get("Autoscaler")
             v = (block or {}).get(field)
+            if isinstance(v, (int, float)):
+                body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
+                            f'{v * scale:g}')
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
+    # self-healing supervision (windflow_tpu.supervision): restart count
+    # + last-event MTTR per graph, so availability is a first-class
+    # Prometheus signal (alert on rate(restart_total) and on
+    # restart_last_seconds spikes)
+    _SUPERVISE_FAMS = (
+        ("windflow_restart_total", "counter",
+         "Supervised automatic restarts of the whole graph",
+         "Supervision_restarts", 1),
+        ("windflow_restart_last_seconds", "gauge",
+         "Detect->resume duration (MTTR) of the last supervised restart",
+         "Supervision_last_restart_s", 1),
+        ("windflow_restart_seconds_total", "counter",
+         "Cumulative detect->resume time across supervised restarts",
+         "Supervision_restart_total_s", 1),
+    )
+    for fam, typ, help_, field, scale in _SUPERVISE_FAMS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            v = (st.get("Supervision") or {}).get(field)
             if isinstance(v, (int, float)):
                 body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
                             f'{v * scale:g}')

@@ -45,6 +45,11 @@ class StatsRecord:
         # commits on coordinator finalize, aborts on restore/duplicate
         # discard, and fenced (refused) writes from stale zombie replicas
         "txn_precommits", "txn_commits", "txn_aborts", "txn_fenced_writes",
+        # per-record error policies + dead-letter queue
+        # (windflow_tpu.supervision.errors): quarantined records,
+        # policy-skipped records, retry attempts; and Kafka transient-
+        # error reconnect/retry events (kafka/connectors.py)
+        "dlq_records", "dlq_skipped", "dlq_retries", "kafka_reconnects",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -113,6 +118,10 @@ class StatsRecord:
         self.txn_commits = 0
         self.txn_aborts = 0
         self.txn_fenced_writes = 0
+        self.dlq_records = 0
+        self.dlq_skipped = 0
+        self.dlq_retries = 0
+        self.kafka_reconnects = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -302,6 +311,13 @@ class StatsRecord:
             "Compile_last_usec": round(self.compile_last_us, 1),
             "Compile_last_signature": self.compile_last_signature,
             "Compile_cache_hits": self.compile_cache_hits,
+            # per-record error policies / dead-letter quarantine
+            # (0s on the default FAIL policy)
+            "Dlq_records": self.dlq_records,
+            "Dlq_skipped": self.dlq_skipped,
+            "Dlq_retries": self.dlq_retries,
+            # Kafka transient-error retry/backoff (kafka/connectors.py)
+            "Kafka_reconnects": self.kafka_reconnects,
             # worker crash visibility (Worker records on its error path)
             "Worker_crashes": self.worker_crashes,
             "Worker_last_error": self.worker_last_error,

@@ -152,6 +152,20 @@ function render(snap){
      `${rs.Rescale_last_to|0}, pause `+
      `${fmt((rs.Rescale_last_pause_s||0)*1e3)}ms)` : "")+`</span>` : "";
   if (rbadge) el("badges").innerHTML += rbadge;
+  // supervised-restart badge: restarts so far + last MTTR; warn style
+  // while escalated (the graph gave up and surfaced the aggregate error)
+  const sv = (st.Supervision||{});
+  const rst = sv.Supervision_restarts|0;
+  if (rst || sv.Supervision_escalated)
+    el("badges").innerHTML +=
+      `<span class="badge ${sv.Supervision_escalated?'warn':''}">`+
+      `restarts ${rst}`+
+      (rst ? ` (MTTR ${fmt((sv.Supervision_last_restart_s||0)*1e3)}ms)`
+           : "")+
+      (sv.Supervision_escalated ? " — escalated" : "")+`</span>`;
+  const dlq = st.Dead_letters|0;
+  if (dlq) el("badges").innerHTML +=
+    `<span class="badge warn">dead letters ${fmt(dlq)}</span>`;
   sparkLine("sparklat", lhist[current], "#b0452b", "µs", rmark[current]);
   const svg = (snap.svgs||{})[current];  // server-sanitized
   el("diagram").innerHTML = "<summary>dataflow graph</summary>"+

@@ -77,6 +77,10 @@ class BasicOperator:
         # None falls back to the graph-level setting, then
         # WF_FLIGHTREC_EVENTS (monitoring/flightrec.py; 0 = off)
         self.flightrec_events: Optional[int] = None
+        # per-record error policy (windflow_tpu.supervision.errors):
+        # None/FAIL = the pre-existing fail-fast behavior, zero new cost;
+        # SKIP / RETRY / DEAD_LETTER wrap functor invocation per record
+        self.error_policy = None
         self._used = False  # operators are copied into the pipe; guard reuse
 
     # hooks -----------------------------------------------------------------
@@ -118,6 +122,13 @@ class BasicReplica:
         # stats histogram when sampling is on; None keeps the per-message
         # tracing check to one attribute load
         self._e2e = None
+        # per-record error policy: a non-FAIL policy shadows process with
+        # a guarded wrapper (instance attribute); the FAIL default leaves
+        # the class method untouched — zero cost on the hot path
+        pol = op.error_policy
+        if pol is not None and not pol.is_fail:
+            from ..supervision.errors import make_guarded_process
+            self.process = make_guarded_process(self, pol)
 
     # -- wiring --------------------------------------------------------------
     def set_emitter(self, emitter: BasicEmitter) -> None:

@@ -20,8 +20,13 @@ import time
 from collections import deque
 from typing import Any, List, Optional, Tuple
 
-from ..basic import DEFAULT_BUFFER_CAPACITY
+from ..basic import DEFAULT_BUFFER_CAPACITY, SupervisorTeardown
 from ..message import EOS_SENTINEL
+
+
+def _teardown() -> SupervisorTeardown:
+    return SupervisorTeardown(
+        "channel closed: the supervisor is rebuilding the runtime plane")
 # flight-recorder spans for blocked puts/gets: recorded into the CALLING
 # thread's own ring (a producer blocks on the consumer's channel, so the
 # channel itself cannot own a single-writer ring); only the already-slow
@@ -37,7 +42,7 @@ class Channel:
 
     __slots__ = ("_q", "_lock", "_not_empty", "_not_full", "capacity",
                  "n_inputs", "depth_max", "puts_blocked", "blocked_put_ns",
-                 "blocked_get_ns")
+                 "blocked_get_ns", "closed")
 
     def __init__(self, capacity: int = DEFAULT_BUFFER_CAPACITY) -> None:
         self._q: deque = deque()
@@ -46,6 +51,11 @@ class Channel:
         self._not_full = threading.Condition(self._lock)
         self.capacity = capacity
         self.n_inputs = 0  # number of producer edges; assigned at wiring
+        # supervised teardown (windflow_tpu.supervision): close() poisons
+        # the channel — every blocked and future put/get raises
+        # SupervisorTeardown so the whole plane unwinds without an EOS
+        # cascade. One bool check on paths that already hold the lock.
+        self.closed = False
         # backpressure / occupancy instrumentation (monitoring plane):
         # producers blocked on a full queue (this stage IS the bottleneck)
         # vs the consumer blocked on an empty one (it is starved). Clocks
@@ -64,11 +74,15 @@ class Channel:
 
     def put(self, ch_idx: int, msg: Any) -> None:
         with self._not_full:
+            if self.closed:
+                raise _teardown()
             if len(self._q) >= self.capacity:
                 self.puts_blocked += 1
                 t0 = time.monotonic_ns()
                 while len(self._q) >= self.capacity:
                     self._not_full.wait()
+                    if self.closed:
+                        raise _teardown()
                 dt = time.monotonic_ns() - t0
                 self.blocked_put_ns += dt
                 rec = thread_recorder()
@@ -87,9 +101,13 @@ class Channel:
         if timeout is None:
             with self._not_empty:
                 if not self._q:
+                    if self.closed:
+                        raise _teardown()
                     t0 = time.monotonic_ns()
                     while not self._q:
                         self._not_empty.wait()
+                        if self.closed and not self._q:
+                            raise _teardown()
                     dt = time.monotonic_ns() - t0
                     self.blocked_get_ns += dt
                     rec = thread_recorder()
@@ -101,8 +119,12 @@ class Channel:
         deadline = time.monotonic() + timeout
         with self._not_empty:
             if not self._q:
+                if self.closed:
+                    raise _teardown()
                 t0 = time.monotonic_ns()
                 while not self._q:
+                    if self.closed:
+                        raise _teardown()
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         self.blocked_get_ns += time.monotonic_ns() - t0
@@ -127,6 +149,17 @@ class Channel:
             item = self._q.popleft()
             self._not_full.notify()
             return item
+
+    def close(self) -> None:
+        """Poison the channel (supervised teardown): every blocked and
+        future put/get raises ``SupervisorTeardown``. Buffered messages
+        still drain through ``get`` — only an EMPTY closed channel
+        raises on the consumer side, so a worker unwinds at a message
+        boundary, never mid-prefix. Idempotent."""
+        with self._lock:
+            self.closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def __len__(self) -> int:
         with self._lock:

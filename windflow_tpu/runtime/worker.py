@@ -59,6 +59,11 @@ class Worker(threading.Thread):
         # watchdog needs idle ticks even without idle sinks, so a
         # blocked-forever-on-input worker still advances its counter
         self.on_crash: Optional[Any] = None
+        # supervised recovery (windflow_tpu.supervision): when wired, a
+        # dying worker notifies the supervisor and exits WITHOUT the
+        # drain + emergency-EOS unwind — an EOS mid-recovery would tell
+        # sinks the stream completed; the supervisor owns the teardown
+        self.on_failure: Optional[Any] = None
         self.force_idle_tick = False
         self._progress = 0  # channel deliveries + idle ticks (watchdog)
         self._eos_seen = 0
@@ -119,6 +124,16 @@ class Worker(threading.Thread):
                 self._record_crash(e)
             except BaseException:
                 pass
+            if self.on_failure is not None:
+                # supervised: the supervisor tears the plane down and
+                # restores from checkpoint — no drain (the channels are
+                # about to be discarded) and NO emergency EOS (sinks
+                # must not see an end-of-stream marker mid-recovery)
+                try:
+                    self.on_failure(self)
+                except BaseException:
+                    pass
+                return
             # unwind so sibling workers never block on us: swallow the rest
             # of our input, then force EOS downstream
             try:

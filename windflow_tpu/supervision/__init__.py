@@ -1,0 +1,27 @@
+"""Self-healing supervision (no reference analog — the C++ WindFlow
+runtime prints the first functor error and ``exit(EXIT_FAILURE)``).
+
+Two planes close the loop between "fault-tolerant" and "self-healing":
+
+- :mod:`supervisor` — graph-level auto-recovery: a supervisor thread
+  watches worker deaths and stall-watchdog episodes, tears the runtime
+  plane down, restores from the latest committed checkpoint and resumes
+  the sources from their recorded positions, under a jittered
+  exponential-backoff restart policy with a bounded restart budget
+  (:class:`RestartPolicy`). Exactly-once sinks stay duplicate-free
+  across restarts via the epoch/generation fencing of
+  ``windflow_tpu.sinks.transactional``.
+- :mod:`errors` — per-record failure containment: operator-level error
+  policies (``FAIL`` default, ``SKIP``, ``RETRY(n, backoff)``,
+  ``DEAD_LETTER``) wrap functor invocation on the host path and
+  bisect device batches to isolate the offending record on the device
+  path; quarantined records land in a :class:`DeadLetterQueue` with
+  full exception metadata.
+"""
+
+from .errors import DeadLetterQueue, ErrorPolicy
+from .policy import RestartPolicy
+from .supervisor import SupervisionEscalated, Supervisor
+
+__all__ = ["RestartPolicy", "ErrorPolicy", "DeadLetterQueue",
+           "Supervisor", "SupervisionEscalated"]

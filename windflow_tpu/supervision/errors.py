@@ -1,0 +1,315 @@
+"""Per-record failure containment: error policies + the dead-letter queue.
+
+The default (``ErrorPolicy.FAIL``) is the pre-existing behavior — a
+functor exception kills the worker and (without supervision) the graph.
+Any other policy wraps functor invocation so one poison tuple no longer
+takes the pipeline down:
+
+- ``SKIP``       — drop the record, count it (``Dlq_skipped``);
+- ``RETRY(n)``   — re-invoke with exponential backoff, then apply the
+                   ``on_exhausted`` fallback (default ``dead_letter``);
+- ``DEAD_LETTER``— quarantine record + exception metadata into the
+                   graph's :class:`DeadLetterQueue` (``Dlq_records``).
+
+Host path: ``BasicReplica`` swaps its ``process`` for a guarded wrapper
+at construction (instance attribute — the FAIL default pays nothing).
+Device path: whole batches run one XLA program, so a failing batch is
+BISECTED — each half re-runs until the offending record is isolated at
+size 1 and the policy applies to that single record (the batch-splitting
+analog of per-tuple wrapping; see ``TPUReplicaBase.handle_msg``).
+
+Only ``Exception`` is contained: ``BaseException`` control-flow signals
+(``RescaleTeardown``/``SupervisorTeardown``, KeyboardInterrupt) always
+propagate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..basic import WindFlowError
+
+_KINDS = ("fail", "skip", "retry", "dead_letter")
+
+
+class ErrorPolicy:
+    """Per-operator record-failure policy. Use the factory constructors
+    (``ErrorPolicy.FAIL``/``SKIP``/``DEAD_LETTER`` or
+    ``ErrorPolicy.RETRY(n, ...)``) rather than ``__init__``."""
+
+    __slots__ = ("kind", "retries", "backoff_s", "backoff_factor",
+                 "on_exhausted", "dlq")
+
+    FAIL: "ErrorPolicy"
+    SKIP: "ErrorPolicy"
+    DEAD_LETTER: "ErrorPolicy"
+
+    def __init__(self, kind: str, retries: int = 0, backoff_s: float = 0.0,
+                 backoff_factor: float = 2.0,
+                 on_exhausted: str = "dead_letter") -> None:
+        if kind not in _KINDS:
+            raise WindFlowError(
+                f"ErrorPolicy: unknown kind {kind!r} (choose from {_KINDS})")
+        if on_exhausted not in ("fail", "skip", "dead_letter"):
+            raise WindFlowError(
+                f"ErrorPolicy: on_exhausted must be fail/skip/dead_letter, "
+                f"got {on_exhausted!r}")
+        self.kind = kind
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.on_exhausted = on_exhausted
+        # the graph injects its DeadLetterQueue at build time when the
+        # policy can dead-letter and none was given explicitly
+        self.dlq: Optional["DeadLetterQueue"] = None
+
+    @classmethod
+    def RETRY(cls, retries: int, backoff_s: float = 0.01,
+              backoff_factor: float = 2.0,
+              on_exhausted: str = "dead_letter") -> "ErrorPolicy":
+        """Re-invoke the functor up to ``retries`` extra times with
+        exponential backoff (``backoff_s * factor**attempt`` sleeps),
+        then apply ``on_exhausted`` ("fail" | "skip" | "dead_letter").
+        Note: a functor with partial side effects before the raise (a
+        FlatMap that pushed some outputs) duplicates them on retry —
+        retry suits idempotent/pure functors."""
+        if retries < 1:
+            raise WindFlowError("ErrorPolicy.RETRY: retries must be >= 1")
+        return cls("retry", retries, backoff_s, backoff_factor, on_exhausted)
+
+    @property
+    def is_fail(self) -> bool:
+        return self.kind == "fail"
+
+    @property
+    def may_dead_letter(self) -> bool:
+        return self.kind == "dead_letter" or (
+            self.kind == "retry" and self.on_exhausted == "dead_letter")
+
+    @classmethod
+    def parse(cls, spec: str) -> "ErrorPolicy":
+        """Env-knob form (``WF_ERROR_POLICY``): ``fail`` | ``skip`` |
+        ``dead_letter`` | ``retry:N``."""
+        s = spec.strip().lower()
+        if s.startswith("retry"):
+            n = int(s.split(":", 1)[1]) if ":" in s else 1
+            return cls.RETRY(n)
+        return {"fail": cls.FAIL, "skip": cls.SKIP,
+                "dead_letter": cls.DEAD_LETTER}.get(s) or cls(s)
+
+    def __repr__(self) -> str:
+        if self.kind == "retry":
+            return (f"ErrorPolicy.RETRY({self.retries}, "
+                    f"on_exhausted={self.on_exhausted!r})")
+        return f"ErrorPolicy.{self.kind.upper()}"
+
+
+ErrorPolicy.FAIL = ErrorPolicy("fail")
+ErrorPolicy.SKIP = ErrorPolicy("skip")
+ErrorPolicy.DEAD_LETTER = ErrorPolicy("dead_letter")
+
+
+def _safe_repr(payload: Any, limit: int = 512) -> str:
+    try:
+        r = repr(payload)
+    except Exception:
+        r = f"<unreprable {type(payload).__name__}>"
+    return r if len(r) <= limit else r[:limit] + "…"
+
+
+class DeadLetterQueue:
+    """Graph-level quarantine side-channel: a bounded in-memory ring of
+    dead-letter records (newest kept) plus an optional on-disk JSONL
+    stream (``WF_DLQ_DIR``/``dir``: one ``<graph>.dlq.jsonl`` file, one
+    JSON object per quarantined record — the durable DLQ a downstream
+    re-drive job consumes).
+
+    Record schema (both forms)::
+
+        {"operator": str, "replica": int, "payload": repr, "ts": int,
+         "error": "Type: message", "traceback": str, "wall_time": float}
+
+    The in-memory ring additionally keeps the live payload OBJECT under
+    ``"payload_obj"`` for same-process inspection/re-injection.
+    """
+
+    def __init__(self, graph_name: str = "pipegraph", capacity: int = 10_000,
+                 dir: Optional[str] = None) -> None:
+        self.graph_name = graph_name
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.total = 0  # ever quarantined (the ring may have evicted)
+        self._dir = dir if dir is not None else os.environ.get("WF_DLQ_DIR")
+        self._path: Optional[str] = None
+        if self._dir:
+            safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                           for c in graph_name) or "pipegraph"
+            self._path = os.path.join(self._dir, f"{safe}.dlq.jsonl")
+
+    def put(self, operator: str, replica: int, payload: Any, ts: int,
+            exc: BaseException) -> Dict[str, Any]:
+        rec = {
+            "operator": operator,
+            "replica": int(replica),
+            "payload": _safe_repr(payload),
+            "ts": int(ts),
+            "error": f"{type(exc).__name__}: {exc}",
+            "traceback": "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__)),
+            "wall_time": time.time(),
+        }
+        with self._lock:
+            self.total += 1
+            self._ring.append({**rec, "payload_obj": payload})
+            if self._path is not None:
+                self._append_jsonl(rec)
+        return rec
+
+    def _append_jsonl(self, rec: Dict[str, Any]) -> None:
+        import json
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            with open(self._path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # a full disk must not turn quarantine into a crash
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+
+_DEFAULT_DLQ: Optional[DeadLetterQueue] = None
+
+
+def _default_dlq() -> DeadLetterQueue:
+    """Fallback quarantine for replicas driven outside a PipeGraph."""
+    global _DEFAULT_DLQ
+    if _DEFAULT_DLQ is None:
+        _DEFAULT_DLQ = DeadLetterQueue("standalone")
+    return _DEFAULT_DLQ
+
+
+# ---------------------------------------------------------------------------
+# host-path guard (wired by BasicReplica when the policy is not FAIL)
+# ---------------------------------------------------------------------------
+def apply_record_policy(replica, policy: ErrorPolicy, payload: Any, ts: int,
+                        exc: Exception, invoke=None) -> None:
+    """One failed record under a non-FAIL policy. ``invoke`` re-runs the
+    record for RETRY (None = not retryable in this context: the retry
+    budget is charged, then the fallback applies directly)."""
+    stats = replica.stats
+    kind = policy.kind
+    if kind == "retry" and invoke is not None:
+        last = exc
+        for attempt in range(policy.retries):
+            stats.dlq_retries += 1
+            delay = policy.backoff_s * (policy.backoff_factor ** attempt)
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                invoke()
+                return  # healed
+            except Exception as e:  # noqa: BLE001 — policy boundary
+                last = e
+        exc, kind = last, policy.on_exhausted
+    elif kind == "retry":
+        kind = policy.on_exhausted
+    if kind == "fail":
+        raise exc
+    if kind == "skip":
+        stats.dlq_skipped += 1
+        stats.inputs_ignored += 1
+        return
+    # dead_letter — DLQ resolution: the graph injects a per-OP queue at
+    # build (op._dlq; never stored on the policy object, which may be
+    # the shared DEAD_LETTER singleton), an explicit policy.dlq wins,
+    # and replicas driven outside a PipeGraph fall back to a module
+    # default so quarantine never crashes
+    dlq = getattr(replica.op, "_dlq", None)
+    if dlq is None:  # explicit is-None: an EMPTY queue is falsy (__len__)
+        dlq = policy.dlq
+    if dlq is None:
+        dlq = _default_dlq()
+    dlq.put(replica.op.name, replica.idx, payload, ts, exc)
+    stats.dlq_records += 1
+    stats.inputs_ignored += 1
+    rec = stats.recorder
+    if rec is not None:
+        try:
+            rec.event("dlq:quarantine", 0.0,
+                      {"op": replica.op.name,
+                       "error": f"{type(exc).__name__}: {exc}"})
+        except Exception:
+            pass  # telemetry must never fail the quarantine
+
+
+def make_guarded_process(replica, policy: ErrorPolicy):
+    """The host-path wrapper installed over ``replica.process`` (bound
+    subclass method captured once; the wrapper is an instance attribute,
+    so operators on the default FAIL policy pay nothing)."""
+    raw = replica.process
+
+    def guarded(payload, ts, wm, tag):
+        try:
+            return raw(payload, ts, wm, tag)
+        except Exception as exc:  # noqa: BLE001 — the policy boundary
+            apply_record_policy(replica, policy, payload, ts, exc,
+                                invoke=lambda: raw(payload, ts, wm, tag))
+
+    return guarded
+
+
+# ---------------------------------------------------------------------------
+# device-path bisection (TPUReplicaBase.handle_msg under a non-FAIL policy)
+# ---------------------------------------------------------------------------
+def split_batch(batch) -> List[Any]:
+    """Bisect a ``BatchTPU`` into two half batches (device column slices
+    + matching host metadata) for poison isolation. Slicing device
+    arrays stays on-device; per-batch key-slot metadata is dropped (the
+    consuming keyed op recomputes it lazily, as it does for any batch)."""
+    from ..tpu.batch import BatchTPU
+
+    n = batch.size
+    mid = n // 2
+    out = []
+    for lo, hi in ((0, mid), (mid, n)):
+        if hi <= lo:
+            continue
+        fields = {name: col[lo:hi] for name, col in batch.fields.items()}
+        keys = (batch.host_keys[lo:hi] if batch.host_keys is not None
+                else None)
+        nb = BatchTPU(fields, batch.ts_host[lo:hi], hi - lo, batch.schema,
+                      batch.wm, keys)
+        nb.stream_tag = batch.stream_tag
+        nb.copy_trace_from(batch)
+        out.append(nb)
+    return out
+
+
+def batch_row_payload(batch, idx: int = 0) -> Dict[str, Any]:
+    """Materialize one row of a device batch as a host dict (the
+    dead-letter payload for an isolated poison record)."""
+    import numpy as np
+
+    row = {}
+    for name, col in batch.fields.items():
+        try:
+            row[name] = np.asarray(col)[idx].item()
+        except Exception:
+            row[name] = f"<unreadable column {name}>"
+    return row

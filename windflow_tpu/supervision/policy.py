@@ -1,0 +1,108 @@
+"""RestartPolicy: when and how fast the supervisor restarts the graph.
+
+The policy is pure decision logic (no threads): the supervisor asks it
+for the next backoff delay and whether another restart fits the budget.
+Restarts are counted inside a sliding window — a graph that crashes
+steadily burns through the budget and escalates, while one that crashed
+once a week ago restarts with a fresh budget and minimal backoff.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import List, Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default  # malformed knob must not take down the graph
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class RestartPolicy:
+    """Jittered exponential backoff + a bounded restart budget.
+
+    ``max_restarts`` restarts are allowed per sliding ``window_s``
+    window; one more failure escalates (the supervisor gives up and the
+    aggregated error surfaces in ``wait_end``). The k-th consecutive
+    restart waits ``backoff_s * factor**k`` seconds, capped at
+    ``backoff_max_s``, with uniform jitter in ``[1-jitter, 1]`` of that
+    value so a fleet of supervised graphs never thunders in lockstep.
+    A stretch of ``window_s`` without failures resets the consecutive
+    counter (the backoff re-anchors at ``backoff_s``).
+
+    Env twins (read by :meth:`from_env`): ``WF_SUPERVISE_MAX_RESTARTS``,
+    ``WF_SUPERVISE_WINDOW_S``, ``WF_SUPERVISE_BACKOFF_S``,
+    ``WF_SUPERVISE_BACKOFF_MAX_S``.
+    """
+
+    def __init__(self, max_restarts: int = 5, window_s: float = 300.0,
+                 backoff_s: float = 0.5, backoff_max_s: float = 30.0,
+                 backoff_factor: float = 2.0, jitter: float = 0.5,
+                 restart_on_stall: bool = True,
+                 seed: Optional[int] = None) -> None:
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.backoff_factor = float(backoff_factor)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        # stall-watchdog episodes count as failures (the wedged worker
+        # thread is abandoned — Python threads cannot be killed — and
+        # the runtime plane is rebuilt around it)
+        self.restart_on_stall = bool(restart_on_stall)
+        self._rng = random.Random(seed)
+        self._restarts: List[float] = []  # monotonic stamps, in-window
+
+    @classmethod
+    def from_env(cls) -> "RestartPolicy":
+        return cls(
+            max_restarts=_env_int("WF_SUPERVISE_MAX_RESTARTS", 5),
+            window_s=_env_float("WF_SUPERVISE_WINDOW_S", 300.0),
+            backoff_s=_env_float("WF_SUPERVISE_BACKOFF_S", 0.5),
+            backoff_max_s=_env_float("WF_SUPERVISE_BACKOFF_MAX_S", 30.0))
+
+    # -- budget ------------------------------------------------------------
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        self._restarts = [t for t in self._restarts if t >= cutoff]
+
+    def allow_restart(self, now: Optional[float] = None) -> bool:
+        """True when one more restart fits the in-window budget."""
+        now = time.monotonic() if now is None else now
+        self._prune(now)
+        return len(self._restarts) < self.max_restarts
+
+    def note_restart(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._restarts.append(now)
+
+    @property
+    def consecutive(self) -> int:
+        """Restarts currently inside the window (drives the backoff
+        exponent; an idle window resets it)."""
+        self._prune(time.monotonic())
+        return len(self._restarts)
+
+    # -- backoff -----------------------------------------------------------
+    def next_backoff(self, now: Optional[float] = None) -> float:
+        """Jittered delay before the NEXT restart attempt (seconds)."""
+        now = time.monotonic() if now is None else now
+        self._prune(now)
+        k = len(self._restarts)
+        base = min(self.backoff_s * (self.backoff_factor ** k),
+                   self.backoff_max_s)
+        lo = base * (1.0 - self.jitter)
+        return lo + self._rng.random() * (base - lo)

@@ -1,0 +1,336 @@
+"""Supervisor: graph-level auto-recovery from worker deaths and stalls.
+
+The reference runtime (and this reproduction, pre-supervision) dies with
+the first failed functor: ``wait_end`` re-raises the first worker error
+and the only recovery path is a human calling ``run(restore_from=...)``.
+The supervisor closes the loop with the machinery previous PRs built:
+
+1. **detect** — a worker's error path notifies the supervisor (plus a
+   polling sweep that also consumes ``StallWatchdog`` episodes);
+2. **back off** — jittered exponential delay under the
+   :class:`~windflow_tpu.supervision.policy.RestartPolicy` budget; an
+   exhausted budget ESCALATES: the supervisor stands down and
+   ``wait_end`` raises the aggregated error;
+3. **tear down** — abort pending checkpoint epochs (exactly-once sinks
+   learn their staged epochs will never finalize via the coordinator's
+   abort listeners), close every channel so blocked producers/consumers
+   unwind with ``SupervisorTeardown`` (no EOS cascade — sinks must NOT
+   see an end-of-stream marker mid-recovery), and join the old workers
+   (a genuinely wedged thread is abandoned: Python threads cannot be
+   killed; its next channel touch raises the teardown signal, and
+   exactly-once sinks fence its zombie writes);
+4. **restore** — rebuild the runtime plane from the stage IR
+   (``PipeGraph._rebuild_runtime``, the rescale path) and push the
+   latest COMMITTED checkpoint's blobs back in: sources resume from
+   their recorded positions, exactly-once sinks roll staged epochs
+   forward/abort per the 2PC recovery contract — restarts are
+   duplicate-free out of the box;
+5. **resume** — fresh workers start; cumulative crash/DLQ counters are
+   carried over so dashboards do not zero out after recovery. The
+   detect→resume time is the per-event MTTR
+   (``Supervision_last_restart_s`` / ``windflow_restart_last_seconds``).
+
+With no committed checkpoint yet, the rebuild restores nothing: source
+functors keep their in-memory cursors, so the stream continues from the
+crash point (records buffered in the discarded channels are lost — run
+with checkpointing for loss-free recovery; supervision enables it
+implicitly, the first interval/triggered epoch closes the window).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..basic import WindFlowError
+from .policy import RestartPolicy
+
+
+class SupervisionEscalated(WindFlowError):
+    """The restart budget is exhausted (or recovery itself failed): the
+    aggregated error of every dead worker, raised by ``wait_end``.
+    ``worker_errors`` maps worker name -> exception."""
+
+    def __init__(self, msg: str,
+                 worker_errors: Optional[Dict[str, BaseException]] = None
+                 ) -> None:
+        super().__init__(msg)
+        self.worker_errors = dict(worker_errors or {})
+
+
+class Supervisor(threading.Thread):
+    """One per supervised PipeGraph; started by ``PipeGraph.start`` and
+    stopped by ``wait_end``. All recovery work runs on this thread."""
+
+    _TICK_S = 0.05
+
+    def __init__(self, graph, policy: Optional[RestartPolicy] = None) -> None:
+        super().__init__(name=f"{graph.name}/supervisor", daemon=True)
+        self.graph = graph
+        self.policy = policy or RestartPolicy.from_env()
+        self.active = True  # False once escalated or stopped
+        self.escalated: Optional[SupervisionEscalated] = None
+        self.restarts = 0
+        self.last_restart_s = 0.0  # detect -> resume (MTTR) of the last one
+        self.restart_total_s = 0.0
+        self.last_cause = ""
+        self.abandoned: List[str] = []  # wedged worker threads left behind
+        self.history: List[Dict[str, Any]] = []  # bounded, newest last
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._stall_seen = 0  # consumed prefix of watchdog.fired
+        self._rec = None  # lazy flight-recorder ring ("supervise" track)
+
+    # -- wiring ------------------------------------------------------------
+    def note_failure(self, worker) -> None:
+        """Worker error-path hook (any thread): wake the loop now."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self.active = False
+        self._stop_evt.set()
+        self._wake.set()
+
+    # -- flight recorder ---------------------------------------------------
+    def _span(self, name: str, dur_us: float, arg: Any = None) -> None:
+        if self._rec is None:
+            g = self.graph
+            events = g._stage_flightrec_events_max()
+            if events > 0:
+                from ..monitoring.flightrec import FlightRecorder
+                self._rec = FlightRecorder(
+                    events, pid_label="supervise",
+                    tid_label=f"{g.name}/supervisor")
+                g._recorders.append(self._rec)
+        if self._rec is not None:
+            try:
+                self._rec.event(name, dur_us, arg)
+            except Exception:
+                pass  # telemetry must never fail a recovery
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> None:
+        while not self._stop_evt.is_set():
+            self._wake.wait(self._TICK_S)
+            self._wake.clear()
+            if self._stop_evt.is_set() or self.graph._ended:
+                return
+            failed = [w for w in self.graph._workers
+                      if w.error is not None]
+            stalled = self._new_stalls()
+            if failed or stalled:
+                try:
+                    self._recover(failed, stalled)
+                except Exception as e:  # recovery itself failed
+                    self._escalate(failed, stalled,
+                                   reason=f"recovery failed: "
+                                          f"{type(e).__name__}: {e}",
+                                   cause=e)
+                if not self.active:
+                    return
+
+    def _new_stalls(self) -> List[str]:
+        if not self.policy.restart_on_stall:
+            return []
+        wd = self.graph._watchdog
+        if wd is None:
+            return []
+        fired = list(wd.fired)
+        fresh = fired[self._stall_seen:]
+        self._stall_seen = len(fired)
+        # only stalls of CURRENT workers trigger recovery (an abandoned
+        # zombie flagged again must not restart the healthy new plane)
+        live = {w.name for w in self.graph._workers}
+        return [n for n in fresh if n in live]
+
+    # -- recovery ----------------------------------------------------------
+    def _errors_of(self, failed) -> Dict[str, BaseException]:
+        return {w.name: w.error for w in failed if w.error is not None}
+
+    def _escalate(self, failed, stalled, reason: str,
+                  cause: Optional[BaseException] = None) -> None:
+        errors = self._errors_of(failed)
+        parts = [f"{n} ({type(e).__name__}: {e})" for n, e in errors.items()]
+        parts += [f"{n} (stalled)" for n in stalled if n not in errors]
+        exc = SupervisionEscalated(
+            f"supervision gave up after {self.restarts} restart(s): "
+            f"{reason}; dead worker(s): {', '.join(parts) or '<none>'}",
+            errors)
+        if cause is not None:
+            exc.__cause__ = cause
+        elif errors:
+            exc.__cause__ = next(iter(errors.values()))
+        self.escalated = exc
+        self.active = False
+        self._span("supervise:escalate", 0.0, reason)
+        # unwind what is left so wait_end's joins return
+        self._teardown(join_timeout=5.0)
+        self.graph._supervising = False
+
+    def _recover(self, failed, stalled: List[str]) -> None:
+        g = self.graph
+        t_detect = time.monotonic()
+        g._supervising = True  # wait_end spins; the watchdog stands down
+        errors = self._errors_of(failed)
+        cause = "; ".join(
+            [f"{n}: {type(e).__name__}: {e}" for n, e in errors.items()]
+            + [f"{n}: stalled" for n in stalled])
+        self.last_cause = cause
+        self._span("supervise:failure", 0.0, cause)
+        if not self.policy.allow_restart():
+            self._escalate(
+                failed, stalled,
+                reason=f"restart budget exhausted "
+                       f"({self.policy.max_restarts} per "
+                       f"{self.policy.window_s:.0f}s window)")
+            return
+        delay = self.policy.next_backoff()
+        self.policy.note_restart()
+        self._span("supervise:backoff", delay * 1e6,
+                   {"attempt": self.restarts + 1})
+        if self._stop_evt.wait(delay):
+            g._supervising = False
+            return
+        t0 = time.monotonic()
+        self._teardown()
+        self._span("supervise:teardown", (time.monotonic() - t0) * 1e6)
+        t0 = time.monotonic()
+        cid = self._rebuild_and_restore()
+        self._span("supervise:restore", (time.monotonic() - t0) * 1e6,
+                   {"ckpt_id": cid})
+        for w in g._workers:
+            w.start()
+        mttr = time.monotonic() - t_detect
+        self.restarts += 1
+        self.last_restart_s = mttr
+        self.restart_total_s += mttr
+        self.history.append({
+            "t_unix": time.time(), "cause": cause, "ckpt_id": cid,
+            "mttr_s": round(mttr, 6), "backoff_s": round(delay, 6),
+            "abandoned": [n for n in stalled]})
+        del self.history[:-64]
+        g._supervising = False
+        self._span("supervise:resume", mttr * 1e6,
+                   {"restart": self.restarts, "ckpt_id": cid})
+
+    def _teardown(self, join_timeout: float = 10.0) -> None:
+        """Unwind the old runtime plane without an EOS cascade."""
+        g = self.graph
+        coord = g._coordinator
+        if coord is not None:
+            # epochs opened against the dying plane can never complete;
+            # exactly-once sinks are notified their staged epochs will
+            # not finalize (they roll forward/abort on restore instead)
+            coord.abort_pending()
+        for s in g._stages:
+            for ch in s.channels:
+                ch.close()
+        old = list(g._workers)
+        for w in old:
+            if w is not threading.current_thread():
+                w.join(timeout=join_timeout)
+        wedged = [w.name for w in old if w.is_alive()]
+        if wedged:
+            # cannot kill a Python thread: abandon it. Its next channel
+            # touch raises SupervisorTeardown; EO-sink zombies are fenced.
+            self.abandoned.extend(wedged)
+            self._span("supervise:abandon", 0.0, wedged)
+
+    def _rebuild_and_restore(self) -> Optional[int]:
+        """Rebuild the runtime plane and push the latest committed
+        checkpoint back in. Returns the restored checkpoint id (None
+        when no checkpoint has committed yet)."""
+        g = self.graph
+        coord = g._coordinator
+        carry = self._collect_carryover()
+        g._rebuild_runtime()
+        cid = None
+        if coord is not None:
+            cid = coord.store.latest()
+            if cid is None:
+                # no checkpoint has COMMITTED yet: resuming from the
+                # sources' in-memory cursors would silently drop every
+                # record that sat in the discarded channels — reset
+                # replayable sources to their captured INITIAL positions
+                # instead (full replay; exactly-once sinks have
+                # committed nothing, so the replay is duplicate-free)
+                self._reset_sources_to_initial()
+            else:
+                ckpt_dir = coord.store._dirname(cid)
+                manifest = coord.store.load_manifest(ckpt_dir)
+                g._restore_states(
+                    coord.store.load_states(ckpt_dir, manifest))
+                # new epochs continue after the restored one; rebuilt
+                # sources anchor their barrier cursor to requested_id
+                # at Worker construction, which _rebuild_runtime already
+                # ran — keep the ids monotone for the next trigger
+                with coord._lock:
+                    coord._alloc_id = max(coord._alloc_id, cid)
+                    if coord.requested_id < cid:
+                        coord.requested_id = cid
+                    coord.last_completed_id = max(
+                        coord.last_completed_id, cid)
+            coord.expected_acks = len(g._workers)
+            coord.worker_names = [w.name for w in g._workers]
+        self._apply_carryover(carry)
+        return cid
+
+    def _reset_sources_to_initial(self) -> None:
+        initial = getattr(self.graph, "_initial_positions", None) or {}
+        for op in self.graph._ops:
+            for r in op.replicas:
+                pos = initial.get((op.name, r.idx))
+                if pos is not None:
+                    r._restore_position = pos
+                    r.stats.inputs_received = 0  # the stream restarts
+                # exactly-once sinks: the dead generation may have left
+                # pre-committed (.pending / prepared) epochs that no
+                # checkpoint ever finalized. The stream restarts from
+                # ZERO, so the replay regenerates their records — they
+                # must ABORT now; a later checkpointed restore would
+                # otherwise roll them forward and DUPLICATE records
+                # (caught by the double-crash chaos differential)
+                drv = getattr(r, "_txn", None)
+                if drv is not None:
+                    drv.restore({"txn_last_epoch": 0})
+
+    # -- cumulative-counter carryover (dashboards must not zero out) -------
+    _CARRY_FIELDS = ("worker_crashes", "dlq_records", "dlq_skipped",
+                     "dlq_retries", "kafka_reconnects")
+
+    def _collect_carryover(self) -> Dict[Any, Dict[str, Any]]:
+        out: Dict[Any, Dict[str, Any]] = {}
+        for op in self.graph._ops:
+            for r in {id(r): r for r in op.replicas}.values():
+                ent = {f: getattr(r.stats, f, 0)
+                       for f in self._CARRY_FIELDS}
+                ent["worker_last_error"] = r.stats.worker_last_error
+                out[(r.stats.op_name, r.idx)] = ent
+        return out
+
+    def _apply_carryover(self, carry: Dict[Any, Dict[str, Any]]) -> None:
+        for op in self.graph._ops:
+            for r in {id(r): r for r in op.replicas}.values():
+                ent = carry.get((r.stats.op_name, r.idx))
+                if not ent:
+                    continue
+                for f in self._CARRY_FIELDS:
+                    setattr(r.stats, f,
+                            getattr(r.stats, f, 0) + ent.get(f, 0))
+                if ent.get("worker_last_error"):
+                    r.stats.worker_last_error = ent["worker_last_error"]
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "Supervision_restarts": self.restarts,
+            "Supervision_last_restart_s": round(self.last_restart_s, 6),
+            "Supervision_restart_total_s": round(self.restart_total_s, 6),
+            "Supervision_last_cause": self.last_cause,
+            "Supervision_escalated": self.escalated is not None,
+            "Supervision_abandoned_threads": list(self.abandoned),
+            "Supervision_budget_remaining": max(
+                0, self.policy.max_restarts - self.policy.consecutive),
+            "Supervision_history": list(self.history),
+        }

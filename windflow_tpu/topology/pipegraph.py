@@ -81,6 +81,23 @@ class PipeGraph:
         # protocol; per-sink builders (`with_exactly_once()`) opt in
         # individually. Env twin: WF_EXACTLY_ONCE=1
         self._exactly_once = env_flag("WF_EXACTLY_ONCE")
+        # self-healing supervision (windflow_tpu.supervision): a
+        # supervisor thread auto-recovers the graph from worker deaths
+        # and stall episodes under a bounded restart budget; enabled via
+        # with_supervision() or WF_SUPERVISE=1. _supervising flips while
+        # a recovery is in flight (wait_end spins, watchdog stands down)
+        self._supervisor = None
+        self._supervise_policy = None
+        self._supervise_enabled = env_flag("WF_SUPERVISE")
+        self._supervising = False
+        # dead-letter queue (windflow_tpu.supervision.errors): created
+        # lazily when any operator carries a quarantining error policy
+        self._dlq = None
+        # JAX persistent compilation cache (WF_COMPILE_CACHE_DIR /
+        # with_compile_cache): supervised restarts and rescales re-use
+        # compiled chain programs instead of re-tracing from scratch
+        self._compile_cache_dir: Optional[str] = \
+            os.environ.get("WF_COMPILE_CACHE_DIR") or None
         env_iv = os.environ.get("WF_CKPT_INTERVAL")
         if env_iv:
             try:
@@ -105,6 +122,118 @@ class PipeGraph:
             raise WindFlowError("with_exactly_once after start()")
         self._exactly_once = True
         return self
+
+    # ------------------------------------------------------------------
+    # self-healing supervision (windflow_tpu.supervision)
+    # ------------------------------------------------------------------
+    def with_supervision(self, policy: Optional[Any] = None) -> "PipeGraph":
+        """Auto-recover the whole graph from worker deaths and
+        stall-watchdog episodes: a supervisor tears the runtime plane
+        down, restores from the latest committed checkpoint, resumes the
+        sources from their recorded positions and restarts — under a
+        jittered exponential-backoff ``RestartPolicy`` with a bounded
+        restart budget (budget exhausted => the aggregated error raises
+        in ``wait_end``). Exactly-once sinks stay duplicate-free across
+        restarts. Enables checkpointing implicitly when not configured
+        (set an interval for bounded replay). Env twins: ``WF_SUPERVISE=1``
+        plus the ``WF_SUPERVISE_*`` policy knobs."""
+        if self._started:
+            raise WindFlowError("with_supervision after start()")
+        self._supervise_enabled = True
+        self._supervise_policy = policy
+        if not self._ckpt_enabled:
+            self.with_checkpointing()
+        return self
+
+    def with_compile_cache(self, cache_dir: str) -> "PipeGraph":
+        """Point JAX's persistent compilation cache at ``cache_dir`` so
+        supervised restarts and rescales re-use compiled device programs
+        (every chain signature otherwise re-traces+recompiles on each
+        rebuild). Env twin: ``WF_COMPILE_CACHE_DIR``."""
+        if self._started:
+            raise WindFlowError("with_compile_cache after start()")
+        self._compile_cache_dir = cache_dir
+        return self
+
+    def _setup_compile_cache(self) -> None:
+        """Wire the persistent compilation cache before the first device
+        program is traced (called from ``start``; the first rung of the
+        ROADMAP compile-stability item). Thresholds drop to zero so even
+        small chain programs persist — a streaming graph re-runs the
+        SAME signatures forever, which is the cache's best case."""
+        if not self._compile_cache_dir:
+            return
+        import jax
+        os.makedirs(self._compile_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          self._compile_cache_dir)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except (AttributeError, ValueError):
+            pass  # older jax: directory alone still enables the cache
+
+    def _capture_initial_positions(self) -> None:
+        """Supervision prerequisite (before the first tuple ships): each
+        replayable source replica's STARTING cursor. A failure before
+        any checkpoint has committed leaves nothing to restore — the
+        supervisor then resets sources to these positions (a full
+        replay; exactly-once sinks make it duplicate-free) instead of
+        silently resuming from the in-memory cursor and losing the
+        prefix that sat in the discarded channels."""
+        from ..operators.base import arity
+        from ..operators.source import Source as _PlainSource
+        self._initial_positions: Dict[Any, Any] = {}
+        for s in self._stages:
+            if not s.is_source or not isinstance(s.first_op, _PlainSource):
+                continue
+            op = s.first_op
+            snap = getattr(op.func, "snapshot_position", None)
+            if snap is None:
+                continue
+            for r in op.replicas:
+                pos = r._restore_position  # a restore_from= start
+                if pos is None:
+                    pos = (snap(r.context) if arity(snap) >= 1 else snap())
+                self._initial_positions[(op.name, r.idx)] = pos
+
+    def dead_letter_queue(self):
+        """The graph's quarantine side-channel (created on first use; see
+        ``windflow_tpu.supervision.errors.DeadLetterQueue``)."""
+        if self._dlq is None:
+            from ..supervision.errors import DeadLetterQueue
+            self._dlq = DeadLetterQueue(self.name)
+        return self._dlq
+
+    def dead_letters(self) -> List[Dict[str, Any]]:
+        """Records quarantined by DEAD_LETTER error policies (payload,
+        exception metadata, traceback), newest last."""
+        return [] if self._dlq is None else self._dlq.records()
+
+    def _negotiate_error_policies(self) -> None:
+        """First ``_build``: refuse meaningless policies loudly and
+        inject the graph's dead-letter queue into every policy that can
+        quarantine but was not given an explicit DLQ."""
+        for op in self._ops:
+            pol = getattr(op, "error_policy", None)
+            if pol is None or pol.is_fail:
+                continue
+            if op.op_type == OpType.SOURCE:
+                raise WindFlowError(
+                    f"with_error_policy: source {op.name!r} drives its own "
+                    "generation loop — there is no per-record invocation "
+                    "to contain; use with_supervision() for source "
+                    "failures")
+            if pol.may_dead_letter:
+                # per-OP attribute, never the policy object: the
+                # ErrorPolicy.DEAD_LETTER singleton is shared across
+                # graphs, and storing one graph's DLQ on it would route
+                # every later graph's quarantine into the wrong queue
+                # explicit is-None: an (empty) user-provided DLQ is falsy
+                op._dlq = pol.dlq if pol.dlq is not None \
+                    else self.dead_letter_queue()
 
     def _negotiate_exactly_once(self) -> None:
         """Guarantee negotiation (first ``_build``): flip graph-wide
@@ -515,6 +644,7 @@ class PipeGraph:
         # silently ignored the requested guarantee would be worse than
         # the refusal
         self._negotiate_exactly_once()
+        self._negotiate_error_policies()
         for s in self._stages:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)
@@ -778,6 +908,10 @@ class PipeGraph:
                        coordinator=self._coordinator, flightrec=rec)
             if rec is not None:
                 w.on_crash = self._crash_dump
+            if self._supervisor is not None:
+                # supervised: a dying worker wakes the supervisor instead
+                # of draining + forcing EOS (Worker.run error path)
+                w.on_failure = self._supervisor.note_failure
             if stall > 0:
                 w.force_idle_tick = True  # liveness ticks for the watchdog
             stage.workers.append(w)
@@ -790,6 +924,17 @@ class PipeGraph:
         if self._started:
             raise WindFlowError("PipeGraph already started")
         self._validate()
+        # supervision (with_supervision / WF_SUPERVISE=1): the supervisor
+        # exists BEFORE _build so every worker gets its failure hook, and
+        # checkpointing is enabled implicitly — a supervisor without a
+        # checkpoint to restore can only resume from in-memory cursors
+        if self._supervise_enabled:
+            if not self._ckpt_enabled:
+                self.with_checkpointing()
+            from ..supervision.supervisor import Supervisor
+            self._supervisor = Supervisor(self, self._supervise_policy)
+        # persistent compilation cache BEFORE any device program traces
+        self._setup_compile_cache()
         if any(getattr(op, "is_tpu", False) for op in self._ops):
             # initialize the JAX backend on the MAIN thread: lazy first-touch
             # inside a worker thread can deadlock the PJRT client handshake
@@ -824,10 +969,16 @@ class PipeGraph:
             from ..monitoring.monitor import MonitoringThread
             self._monitor = MonitoringThread(self)
             self._monitor.start()
+        if self._supervisor is not None:
+            for w in self._workers:
+                w.on_failure = self._supervisor.note_failure
+            self._capture_initial_positions()
         for w in self._workers:
             w.start()
         if self._watchdog is not None:
             self._watchdog.start()
+        if self._supervisor is not None:
+            self._supervisor.start()
         # autoscaler policy thread (with_autoscaler / WF_AUTOSCALE=1)
         if self._autoscale_enabled or env_flag("WF_AUTOSCALE"):
             from ..scaling.autoscaler import Autoscaler
@@ -840,16 +991,34 @@ class PipeGraph:
         if self._ended:
             return
         while True:
-            # a live rescale REPLACES self._workers mid-run: re-read the
-            # list after every join sweep so we wait on the current plane
+            # a live rescale (or supervised restart) REPLACES
+            # self._workers mid-run: re-read the list after every join
+            # sweep so we wait on the current plane
             workers = self._workers
-            for w in workers:
-                w.join()
-            if self._workers is workers:
-                if not self._rescaling:
-                    break
-                time.sleep(0.05)  # mid-rescale: the new plane is coming
+            try:
+                for w in workers:
+                    w.join()
+            except RuntimeError:
+                # mid-rebuild: the new plane is published but its
+                # threads are not started yet — come back around
+                time.sleep(0.02)
+                continue
+            if self._workers is not workers:
+                continue
+            if self._rescaling or self._supervising:
+                time.sleep(0.05)  # the new plane is coming
+                continue
+            sup = self._supervisor
+            if sup is not None and sup.active \
+                    and any(w.error is not None for w in workers):
+                # a worker died but the supervisor has not reacted yet:
+                # give it the chance (it restarts or escalates)
+                time.sleep(0.02)
+                continue
+            break
         self._ended = True
+        if self._supervisor is not None:
+            self._supervisor.stop()
         if self._autoscaler is not None:
             self._autoscaler.stop()
         self.elapsed_sec = time.monotonic() - self._t0
@@ -860,6 +1029,9 @@ class PipeGraph:
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor.join(timeout=3)
+        if self._supervisor is not None \
+                and self._supervisor.escalated is not None:
+            raise self._supervisor.escalated
         errors = [w.error for w in self._workers if w.error is not None]
         if not errors:
             # exactly-once sinks: the run finished cleanly, so every
@@ -873,7 +1045,14 @@ class PipeGraph:
                     if fin is not None:
                         fin()
         if errors:
-            raise errors[0]
+            if len(errors) == 1:
+                raise errors[0]
+            # SEVERAL workers died: naming only errors[0] silently
+            # discarded the rest — aggregate, naming every dead worker
+            from ..basic import WorkerFailuresError
+            raise WorkerFailuresError(
+                {w.name: w.error for w in self._workers
+                 if w.error is not None}) from errors[0]
         if env_flag("WF_TRACING_ENABLED"):
             self.dump_stats(os.environ.get("WF_LOG_DIR", "log"))
 
@@ -943,6 +1122,10 @@ class PipeGraph:
             st["Rescales"] = self._rescale_ctrl.stats()
         if self._autoscaler is not None:
             st["Autoscaler"] = self._autoscaler.stats()
+        if self._supervisor is not None:
+            st["Supervision"] = self._supervisor.stats()
+        if self._dlq is not None:
+            st["Dead_letters"] = self._dlq.total
         # crash visibility: a worker that died no longer disappears
         # silently — its exception surfaces in the final report (the
         # replica-level Worker_last_error carries the full traceback)

@@ -151,6 +151,14 @@ class Stage:
         chain. Everything else keeps its own stage."""
         if not tpu_fusion_enabled():
             return "device-chain fusion disabled (WF_TPU_FUSION=0)"
+        def _guarded(o):
+            pol = getattr(o, "error_policy", None)
+            return pol is not None and not pol.is_fail
+        if _guarded(op) or any(_guarded(o) for o in self.ops):
+            # poison isolation bisects a failing batch per OPERATOR; one
+            # fused program cannot attribute the error to a sub-op
+            return ("error policy set — poison-record bisection needs "
+                    "the operator's own program boundary")
         if getattr(self.last_op, "fusion_role", None) == "terminator":
             return (f"{self.last_op.name} (global Reduce_TPU) already "
                     "terminates the fused chain")

@@ -243,6 +243,14 @@ class TPUReplicaBase(BasicReplica):
         # device traces line up with the Dispatch_* stats (the commit
         # span lives in the dispatch queue)
         self._span_prep = f"wf:prep:{op.name}"
+        # per-record error policy (windflow_tpu.supervision.errors): a
+        # whole batch shares one XLA program, so a failing batch is
+        # BISECTED until the poison record is isolated at size 1 and the
+        # policy applies to that record. None (FAIL default) keeps the
+        # pipelined hot path untouched.
+        pol = getattr(op, "error_policy", None)
+        self._err_policy = pol if pol is not None and not pol.is_fail \
+            else None
 
     def handle_msg(self, ch: int, msg: Any) -> None:
         if msg.is_punct:
@@ -265,6 +273,10 @@ class TPUReplicaBase(BasicReplica):
             self.stats._svc_rec = True
         self._advance_wm(msg.wm)
         msg.wm = self.cur_wm
+        if self._err_policy is not None:
+            self._process_batch_guarded(msg)
+            self.stats.end_svc(msg.size)
+            return
         t0 = time.perf_counter()
         with device_span(self._span_prep):
             commit = self.prep_device_batch(msg)
@@ -274,6 +286,36 @@ class TPUReplicaBase(BasicReplica):
         else:
             self.stats.note_host_prep(prep_us)  # batch needed no commit
         self.stats.end_svc(msg.size)
+
+    def _process_batch_guarded(self, msg: BatchTPU) -> None:
+        """Policy-guarded batch path: commits run SYNCHRONOUSLY (drain
+        right after submit) so an error attributes to this exact batch,
+        then bisection isolates the offender. Stateless transforms
+        bisect safely; a stateful op whose failure left partial device
+        state applied keeps that prefix (document-level caveat — the
+        FAIL policy is the strict choice for stateful device chains)."""
+        try:
+            t0 = time.perf_counter()
+            with device_span(self._span_prep):
+                commit = self.prep_device_batch(msg)
+            prep_us = (time.perf_counter() - t0) * 1e6
+            if commit is not None:
+                self.dispatch.submit(commit, prep_us)
+                self.dispatch.drain(forced=True)
+            else:
+                self.stats.note_host_prep(prep_us)
+        except Exception as exc:  # noqa: BLE001 — the policy boundary
+            from ..supervision.errors import (apply_record_policy,
+                                              batch_row_payload,
+                                              split_batch)
+            if msg.size <= 1:
+                payload = batch_row_payload(msg, 0) if msg.size else {}
+                ts = int(msg.ts_host[0]) if msg.size else 0
+                apply_record_policy(self, self._err_policy, payload, ts,
+                                    exc)
+                return
+            for half in split_batch(msg):
+                self._process_batch_guarded(half)
 
     def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
         """Host-prep stage: return this batch's device-commit thunk (or
