@@ -814,8 +814,11 @@ def _surge_mode() -> None:
     p99 latency before / during (early surge) / after (late surge, when
     the autoscaler has reacted) for both runs, plus the measured rescale
     pause. CPU-plane by construction (the elastic plane is host-side
-    routing; no TPU relay involved). Writes results/surge.json and
-    prints one JSON line."""
+    routing; no TPU relay involved). A second pair of runs steps to 4x —
+    PAST the autoscaler's MAX_PAR — with the overload governor off
+    (pegged p99: scale-out exhausted) and on (admission control holds
+    p99 inside WF_SURGE_SLO_MS, every shed accounted). Writes
+    results/surge.json and prints one JSON line."""
     import threading
 
     import numpy as np
@@ -933,19 +936,157 @@ def _surge_mode() -> None:
                                   if o["name"] == "hot"][0],
         }
 
+    # ---- 4x surge PAST MAX_PAR: the overload-governor leg -------------
+    # The 2x surge above is absorbable by scale-out; this one is NOT
+    # (offered 4x base vs MAX_PAR=2 replicas of a ~1x-rate operator).
+    # governor=False shows the failure mode the static/autoscaled runs
+    # cannot escape — pegged p99 bounded only by channel capacity;
+    # governor=True must hold p99 inside the SLO by admission control,
+    # with every shed record accounted (offered == admitted + shed).
+    slo_ms = float(os.environ.get("WF_SURGE_SLO_MS", "50"))
+    max_par = int(os.environ.get("WF_SURGE_MAX_PAR", "2"))
+
+    def run_4x(governed: bool) -> dict:
+        from windflow_tpu import GovernorPolicy
+        samples = []
+        lock = threading.Lock()
+        t_start = [0.0]
+        pushed = [0]
+
+        class Surge4xSource:
+            """Replayable across the mid-surge rescale: the cursor AND
+            the elapsed phase clock ride the snapshot, so a restart
+            resumes the rate schedule instead of replaying the ramp."""
+
+            def __init__(self):
+                self.pos = 0
+                self.t_off = 0.0
+
+            def __call__(self, shipper):
+                t0 = time.monotonic() - self.t_off
+                if not t_start[0]:
+                    t_start[0] = t0
+                i = self.pos
+                while True:
+                    t_rel = time.monotonic() - t0
+                    self.t_off = t_rel
+                    if t_rel >= 3 * phase_s:
+                        pushed[0] = i
+                        return
+                    rate = base_rate if t_rel < phase_s else 4 * base_rate
+                    for _ in range(10):
+                        k = int(key_table[i & 0xFFFF])
+                        # cursor BEFORE the push (barriers inject at push
+                        # boundaries): offered == admitted + shed exactly,
+                        # even across the mid-surge rescale
+                        self.pos = i
+                        shipper.push({"key": k, "v": i,
+                                      "t0": time.perf_counter()})
+                        i += 1
+                    self.pos = i
+                    time.sleep(max(0.0, 10 / rate
+                                   - (time.monotonic() - t0 - t_rel)))
+
+            def snapshot_position(self):
+                return (self.pos, self.t_off)
+
+            def restore(self, state):
+                self.pos, self.t_off = state
+
+        def hot_step(t, s):
+            time.sleep(work_s)
+            return t
+
+        def sink(t):
+            if t is None:
+                return
+            lat = (time.perf_counter() - t["t0"]) * 1e6
+            with lock:
+                samples.append((time.monotonic() - t_start[0], lat))
+
+        import shutil
+        store = os.path.join("results", f"surge4x_ckpt_{governed}")
+        shutil.rmtree(store, ignore_errors=True)
+        g = PipeGraph(f"surge4x_{'gov' if governed else 'nogov'}",
+                      ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME,
+                      channel_capacity=128)
+        g.with_checkpointing(store_dir=store)
+        g.with_autoscaler(AutoscalePolicy(
+            interval_s=0.25, cooldown_s=3.0, max_parallelism=max_par,
+            up_blocked_put_ms=20, hysteresis=2, factor=2.0))
+        if governed:
+            g.with_slo(slo_ms, GovernorPolicy(
+                slo_p99_ms=slo_ms, interval_s=0.25, cooldown_s=0.75,
+                breach_hysteresis=2, max_parallelism=max_par))
+        red = Reduce(hot_step, key_extractor=lambda t: t["key"],
+                     name="hot", parallelism=1)
+        g.add_source(Source_Builder(Surge4xSource()).with_name("src")
+                     .build()) \
+            .add(red) \
+            .add_sink(Sink_Builder(sink).with_name("snk").build())
+        g.run()
+        st = g.get_stats()
+        shutil.rmtree(store, ignore_errors=True)
+
+        def p99(lo, hi):
+            window = sorted(v for t, v in samples if lo <= t < hi)
+            if not window:
+                return 0.0
+            return window[min(len(window) - 1,
+                              int(0.99 * (len(window) - 1)))]
+
+        src_reps = [r for o in st["Operators"] if o["name"] == "src"
+                    for r in o["replicas"]]
+        admitted = sum(r["Inputs_received"] for r in src_reps)
+        shed = sum(r["Shed_records"] for r in src_reps)
+        offered = admitted + shed
+        ov = st.get("Overload", {})
+        out = {
+            "delivered": len(samples),
+            "offered": offered, "admitted": admitted, "shed": shed,
+            "shed_fraction": round(shed / offered, 4) if offered else 0.0,
+            "offered_matches_push_count": offered == pushed[0],
+            "p99_before_us": round(p99(phase_s * 0.3, phase_s), 1),
+            "p99_surge_late_us": round(p99(2 * phase_s, 3 * phase_s), 1),
+            "final_parallelism": [o["parallelism"]
+                                  for o in st["Operators"]
+                                  if o["name"] == "hot"][0],
+        }
+        if governed:
+            out["governor"] = {
+                "state": ov.get("Overload_state_name"),
+                "escalations": ov.get("Overload_escalations"),
+                "admit_rate_tps": ov.get("Overload_admit_rate_tps"),
+                "offered_tps": ov.get("Overload_offered_tps"),
+                "admitted_tps": ov.get("Overload_admitted_tps"),
+            }
+        return out
+
     print("surge: static topology run", file=sys.stderr)
     static = run(False)
     print("surge: autoscaled run", file=sys.stderr)
     auto = run(True)
+    print("surge: 4x past MAX_PAR, governor off", file=sys.stderr)
+    gov_off = run_4x(False)
+    print("surge: 4x past MAX_PAR, governor on", file=sys.stderr)
+    gov_on = run_4x(True)
     recovered = (auto["rescale_events"] >= 1
                  and auto["p99_surge_late_us"]
                  < max(1.0, 0.5 * static["p99_surge_late_us"]))
+    governed_held = (gov_on["shed"] > 0
+                     and gov_on["p99_surge_late_us"] < slo_ms * 1e3
+                     <= gov_off["p99_surge_late_us"])
     result = {
         "metric": "surge_p99_recovery (cpu-plane)",
         "zipf_keys": n_keys, "base_rate_tps": base_rate,
         "phase_sec": phase_s,
         "static": static, "autoscaled": auto,
         "autoscaler_recovered_p99": recovered,
+        "surge_4x_past_max_par": {
+            "slo_ms": slo_ms, "max_par": max_par,
+            "governor_off": gov_off, "governor_on": gov_on,
+            "governor_held_slo": governed_held,
+        },
     }
     os.makedirs("results", exist_ok=True)
     with open(os.path.join("results", "surge.json"), "w") as f:

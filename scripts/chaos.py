@@ -46,7 +46,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
-             "supervised_kill")
+             "supervised_kill", "overload_kill")
 
 
 class InjectedCrash(Exception):
@@ -147,6 +147,118 @@ def _verify(golden, crash_res, rest_res, txn_dir):
         problems.append(f"committed segments diverge: got {len(segs)} "
                         f"records, want {len(golden)}")
     return problems
+
+
+def _overload_kill_round(rng, report, workdir) -> dict:
+    """``--overload``: kill a worker MID-SHED and verify supervised
+    recovery. A rate-paced source offers far over a slowed operator's
+    capacity under a tight SLO, so the governor's ladder reaches the
+    shed rung; the source then crashes (supervision ON). Checks:
+
+    - the graph recovers in-process (one supervised restart);
+    - shed counters carry across the restart (they ride the source's
+      checkpoint snapshot + the supervisor's carryover — a shed record
+      is gone for good, so its count must not zero);
+    - offered == admitted + shed EXACTLY, across crash and replay;
+    - the exactly-once sink's committed records are duplicate-free and
+      equal the commit-time functor outputs over the ADMITTED set.
+    """
+    from windflow_tpu import (ExecutionMode, GovernorPolicy, Map_Builder,
+                              PipeGraph, RestartPolicy, Sink_Builder,
+                              Source_Builder, TimePolicy)
+
+    n = 24_000
+    crash_at = rng.randrange(int(n * 0.5), int(n * 0.8))
+    ckpt_at = sorted(rng.sample(range(int(n * 0.1), int(n * 0.45)), 2)
+                     + [crash_at - rng.randrange(200, 2000)])
+    report.update(n=n, crash_at=crash_at, ckpt_at=ckpt_at)
+
+    class OverloadSource:
+        """Paced hot (~20k/s offered vs ~1.5k/s capacity), replayable,
+        crashes once at ``crash_at``."""
+
+        def __init__(self):
+            self.pos = 0
+            self.crashes = 0
+
+        def __call__(self, shipper):
+            while self.pos < n:
+                if self.pos == crash_at and self.crashes < 1:
+                    self.crashes += 1
+                    raise InjectedCrash(f"killed mid-shed at {self.pos}")
+                v = self.pos
+                shipper.push({"v": v})
+                self.pos += 1
+                if self.pos in ckpt_at:
+                    shipper.request_checkpoint()
+                if self.pos % 20 == 0:
+                    time.sleep(0.001)
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    def slow(t):
+        time.sleep(0.0005)
+        return t
+
+    committed_seen = []
+
+    def sink(t):
+        if t is not None:
+            committed_seen.append(t["v"])
+
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    src = OverloadSource()
+    g = PipeGraph("chaos_overload", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME, channel_capacity=256)
+    g.with_checkpointing(store_dir=store)
+    g.with_supervision(RestartPolicy(max_restarts=4, backoff_s=0.02,
+                                     backoff_max_s=0.2))
+    g.with_slo(50.0, GovernorPolicy(slo_p99_ms=50.0, interval_s=0.2,
+                                    cooldown_s=0.4, breach_hysteresis=2))
+    g.add_source(Source_Builder(src).with_name("src").build()) \
+        .add(Map_Builder(slow).with_name("hot").build()) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=txn).build())
+    g.run()  # recovers in-process; raising here fails the round
+
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    ov = st.get("Overload", {})
+    src_reps = [r for o in st["Operators"] if o["name"] == "src"
+                for r in o["replicas"]]
+    admitted = sum(r["Inputs_received"] for r in src_reps)
+    shed = sum(r["Shed_records"] for r in src_reps)
+    problems = []
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if shed <= 0:
+        problems.append("governor never shed (overload not reached)")
+    if admitted + shed != n:
+        problems.append(f"accounting broke across the restart: "
+                        f"admitted {admitted} + shed {shed} != {n}")
+    from windflow_tpu.sinks.transactional import read_committed_records
+    segs = sorted(r["v"] for r, _ in
+                  read_committed_records(os.path.join(txn, "snk_r0")))
+    if len(segs) != len(set(segs)):
+        problems.append(f"duplicates in committed output: "
+                        f"{len(segs) - len(set(segs))}")
+    if segs != sorted(committed_seen):
+        problems.append("committed segments diverge from commit-time "
+                        "functor outputs")
+    report.update(
+        ok=not problems, problems=problems,
+        admitted=admitted, shed=shed,
+        shed_fraction=round(shed / n, 4),
+        governor_state=ov.get("Overload_state_name"),
+        restarts=sup.get("Supervision_restarts", 0),
+        mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
 
 
 def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
@@ -258,6 +370,8 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
             mttr_s=sup.get("Supervision_last_restart_s", 0.0),
             mttr_total_s=sup.get("Supervision_restart_total_s", 0.0))
         return report
+    elif scenario == "overload_kill":
+        return _overload_kill_round(rng, report, workdir)
     else:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(choose from {SCENARIOS})")
@@ -314,12 +428,20 @@ def main() -> int:
                          "graph must recover in-process (no manual "
                          "restore_from) with byte-identical exactly-once "
                          "output; records MTTR per round")
+    ap.add_argument("--overload", action="store_true",
+                    help="kill a worker MID-SHED (overload governor "
+                         "active, supervision ON): recovery must carry "
+                         "shed counters over, keep offered == admitted + "
+                         "shed, and keep the exactly-once output "
+                         "duplicate-free over the admitted set")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (e.g. "
                          "results/chaos.json)")
     args = ap.parse_args()
     if args.supervised:
         scenarios = ("supervised_kill",)
+    elif args.overload:
+        scenarios = ("overload_kill",)
     else:
         scenarios = (args.scenario,) if args.scenario else SCENARIOS
     report = run_sweep(args.seed, args.rounds, scenarios, n=args.n)

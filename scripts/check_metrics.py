@@ -66,6 +66,14 @@ REQUIRED_FAMILIES = (
     # scalars: present with value 0 on every replica when unused)
     "windflow_dlq_records_total",
     "windflow_kafka_reconnects_total",
+    # overload-protection plane (the run declares an SLO, so the
+    # governor reports its state gauge even while idle; shed counters
+    # are per-replica scalars, 0 when nothing sheds)
+    "windflow_shed_records_total",
+    "windflow_shed_bytes_total",
+    "windflow_overload_state",
+    "windflow_overload_escalations_total",
+    "windflow_overload_slo_p99_seconds",
 )
 
 _SAMPLE_RE = re.compile(
@@ -213,6 +221,10 @@ def run_graph_and_scrape():
         from windflow_tpu import RestartPolicy
         g.with_supervision(RestartPolicy(max_restarts=3, backoff_s=0.05,
                                          backoff_max_s=0.2))
+        # overload governor attached but IDLE (a 60 s budget never
+        # breaches): the windflow_overload_* families must export even
+        # when the ladder never engages
+        g.with_slo(60_000.0)
         g.add_source(Source_Builder(src).with_name("src").build()) \
          .add(Map_Builder(lambda t: {"v": t["v"] * 2})
               .with_name("dbl").build()) \

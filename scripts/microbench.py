@@ -851,6 +851,112 @@ def bench_supervise() -> None:
                 "worker failure hook is error-path-only"}))
 
 
+def bench_overload() -> None:
+    """--overload: off-path cost of the overload-protection plane
+    (windflow_tpu.overload) on the per-tuple CPU chain at the 1/64
+    latency acceptance config. Two interleaved legs, best-of-6:
+
+    - ``off``   — no governor (the pre-existing hot path);
+    - ``idle``  — ``with_slo(60s)``: governor thread attached, admission
+      gates NOT engaged — the hot path pays one is-None check per push
+      and the governor ticks at 2 Hz off-thread. Gate: <= 2%.
+
+    Plus one informational ON-path pass (SLO tight enough that the
+    ladder reaches the shed rung): admitted/offered/shed rates and the
+    post-engage p99 — the number PERF.md quotes, not a gate (shedding
+    deliberately trades throughput for latency)."""
+    from windflow_tpu import (ExecutionMode, GovernorPolicy, Map_Builder,
+                              PipeGraph, Sink_Builder, Source_Builder,
+                              TimePolicy)
+
+    N, REPS = 300_000, 6
+
+    def one_pass(slo_ms):
+        def src(shipper):
+            for v in range(N):
+                shipper.push({"v": v})
+
+        seen = [0]
+        builders = (Source_Builder(src),
+                    Map_Builder(lambda t: {"v": t["v"] + 1}),
+                    Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                                 if t else None))
+        for b in builders:
+            # pin the sample rate in BOTH legs: with_slo would otherwise
+            # enable 1/16 sampling and the delta would measure tracing,
+            # not the governor
+            b.with_latency_tracing("1/64")
+        g = PipeGraph("mb_overload", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        if slo_ms is not None:
+            g.with_slo(slo_ms)
+        g.add_source(builders[0].build()) \
+         .chain(builders[1].build()) \
+         .chain_sink(builders[2].build())
+        t0 = time.perf_counter()
+        g.run()
+        tps = N / (time.perf_counter() - t0)
+        return tps, g.get_stats()
+
+    legs = (("off", None), ("idle", 60_000.0))
+    best = {label: 0.0 for label, _ in legs}
+    for _ in range(REPS):
+        for label, slo in legs:
+            tps, _ = one_pass(slo)
+            if tps > best[label]:
+                best[label] = tps
+    for label, _ in legs:
+        report(f"overload_governor_{label}", best[label])
+    base = best["off"]
+    pct = 100.0 * (1.0 - best["idle"] / base) if base else 0.0
+    print(json.dumps({"bench": "overload_idle_overhead_pct",
+                      "value": round(pct, 2), "unit": "pct",
+                      "acceptance": "<=2% governor attached but idle"}))
+
+    # informational ON-path pass: paced offered load far over a slowed
+    # sink's capacity, tight SLO -> the ladder reaches shed
+    lat = []
+    t0g = [0.0]
+
+    def paced_src(shipper):
+        t0g[0] = time.monotonic()
+        i = 0
+        while time.monotonic() - t0g[0] < 4.0:
+            shipper.push({"v": i, "t0": time.perf_counter()})
+            i += 1
+            if i % 20 == 0:
+                time.sleep(0.001)
+
+    def slow_map(t):
+        time.sleep(0.0005)
+        return t
+
+    def lat_sink(t):
+        if t is not None:
+            lat.append((time.monotonic() - t0g[0],
+                        (time.perf_counter() - t["t0"]) * 1e6))
+
+    g = PipeGraph("mb_overload_on", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME, channel_capacity=256)
+    g.with_slo(50.0, GovernorPolicy(slo_p99_ms=50.0, interval_s=0.25,
+                                    cooldown_s=0.5, breach_hysteresis=2))
+    g.add_source(Source_Builder(paced_src).with_name("src").build()) \
+     .add(Map_Builder(slow_map).with_name("work").build()) \
+     .add_sink(Sink_Builder(lat_sink).with_name("snk").build())
+    g.run()
+    ov = g.get_stats()["Overload"]
+    tail = sorted(v for t, v in lat if t >= 2.0)
+    p99 = tail[int(0.99 * (len(tail) - 1))] if tail else 0.0
+    print(json.dumps({"bench": "overload_shed_on_path",
+                      "post_engage_p99_us": round(p99, 1),
+                      "slo_us": ov["Overload_slo_p99_usec"],
+                      "shed_records": ov["Overload_shed_records"],
+                      "offered_tps": ov["Overload_offered_tps"],
+                      "admitted_tps": ov["Overload_admitted_tps"],
+                      "note": "informational: shedding trades throughput "
+                              "for bounded latency by design"}))
+
+
 def bench_restart() -> None:
     """--restart: cold-vs-warm restart-to-first-tuple time with the JAX
     persistent compilation cache (WF_COMPILE_CACHE_DIR /
@@ -864,7 +970,13 @@ def bench_restart() -> None:
       jit entries, so they re-TRACE, but XLA compilation is served from
       the persistent cache — exactly the supervised-restart/rescale
       path;
-    - ``warm2`` — repeat, confirming steady state.
+    - ``warm2`` — repeat, confirming steady state;
+    - ``prewarmed`` — warm cache + ``with_prewarm()``: every bucket
+      signature compiles at start() BEFORE the sources open (ROADMAP
+      compile-stability item, completed), so cold-start moves from the
+      first batch into start() and the STREAM itself never traces —
+      the pass also reports start->first-tuple with that cost folded in,
+      plus the prewarm report (signatures, elapsed).
 
     Reported metric: start() -> first tuple at the sink. Gate: REPORT
     the ratio (the win scales with program complexity; a trivial program
@@ -879,7 +991,7 @@ def bench_restart() -> None:
     cache = tempfile.mkdtemp(prefix="wf_mb_cache_")
     N, B = 4096, 512
 
-    def one_pass():
+    def one_pass(prewarm=False):
         def src(shipper):
             for v in range(N):
                 shipper.push({"v": np.int32(v)})
@@ -893,20 +1005,34 @@ def bench_restart() -> None:
         g = PipeGraph("mb_restart", ExecutionMode.DEFAULT,
                       TimePolicy.INGRESS_TIME)
         g.with_compile_cache(cache)
+        if prewarm:
+            g.with_prewarm()
         g.add_source(Source_Builder(src)
                      .with_output_batch_size(B).build()) \
          .add(Map_TPU_Builder(
               lambda f: {**f, "v": f["v"] * 3 + 7}).with_name("dm")
+              .with_schema({"v": np.int32})
               .build()) \
          .add_sink(Sink_Builder(sink).build())
         t0 = time.perf_counter()
         g.run()
-        return (first[0] - t0) * 1e3 if first[0] else float("nan")
+        ms = (first[0] - t0) * 1e3 if first[0] else float("nan")
+        return ms, g.prewarm_report
 
     results = {}
     for label in ("cold", "warm", "warm2"):
-        results[label] = one_pass()
+        results[label], _ = one_pass()
         report(f"restart_to_first_tuple_{label}", results[label], "ms")
+    pre_ms, pre_rep = one_pass(prewarm=True)
+    results["prewarmed"] = pre_ms
+    report("restart_to_first_tuple_prewarmed", pre_ms, "ms")
+    if pre_rep is not None:
+        print(json.dumps({"bench": "restart_prewarm_report",
+                          "signatures": pre_rep["signatures_compiled"],
+                          "bucket_caps": pre_rep["bucket_caps"],
+                          "prewarm_ms":
+                              round(pre_rep["elapsed_s"] * 1e3, 1),
+                          "skipped": pre_rep["skipped"]}))
     if results["cold"] and results["warm"]:
         print(json.dumps({"bench": "restart_warm_vs_cold",
                           "value": round(results["cold"]
@@ -1036,6 +1162,9 @@ def main() -> None:
         return
     if "--flightrec" in sys.argv[1:]:
         bench_flightrec()
+        return
+    if "--overload" in sys.argv[1:]:
+        bench_overload()
         return
     bench_staging()
     bench_reshard()

@@ -48,6 +48,19 @@ def test_chaos_supervised_kill(tmp_path, seed):
     assert rep["mttr_s"] > 0
 
 
+@pytest.mark.parametrize("seed", [12, 29])
+def test_chaos_overload_kill(tmp_path, seed):
+    """Kill a worker MID-SHED (overload governor active, supervision
+    ON): recovery carries the shed counters over (offered == admitted +
+    shed exactly, across crash and replay) and the exactly-once output
+    stays duplicate-free over the admitted set."""
+    rep = chaos.run_round(seed, "overload_kill", str(tmp_path))
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] == 1
+    assert rep["shed"] > 0
+    assert rep["governor_state"] is not None
+
+
 @pytest.mark.slow
 def test_chaos_sweep(tmp_path):
     rep = chaos.run_sweep(31, rounds=6, workdir=str(tmp_path))

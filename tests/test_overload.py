@@ -1,0 +1,591 @@
+"""Overload-protection plane tests (windflow_tpu.overload).
+
+Units: token bucket, admission-gate shed policies (drop_newest /
+drop_oldest / probabilistic / key_priority), governor ladder policy,
+autoscaler scale-down interlock, stall-watchdog stand-down.
+
+End-to-end: a sustained-overload soak (offered rate far over capacity
+with no scale headroom) proving the governor holds windowed p99 inside
+the declared SLO by shedding at source admission, with EXACT accounting
+(offered == admitted + shed, shed log line per shed) and exactly-once
+sink output byte-identical to a no-overload run over the admitted
+record set; plus the compile-stability pre-warm soak (ragged device
+stream, ``Compile_count`` flat after warm-up).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+import types
+
+import pytest
+
+from windflow_tpu import (ExecutionMode, GovernorPolicy, Map_Builder,
+                          PipeGraph, Sink_Builder, Source_Builder,
+                          TimePolicy, TokenBucket, WindFlowError)
+from windflow_tpu.monitoring.stats import StatsRecord
+from windflow_tpu.overload.admission import (AdmissionGate, ShedLog,
+                                             parse_shed_policy)
+from windflow_tpu.overload.governor import IDLE, SHED, TUNE
+from windflow_tpu.scaling.autoscaler import AutoscalePolicy
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+def test_token_bucket_refill_and_burst():
+    tb = TokenBucket(1000.0, burst=10.0)
+    granted = sum(tb.try_take() for _ in range(50))
+    assert granted <= 11  # burst + at most a token of refill
+    time.sleep(0.05)
+    assert tb.try_take()  # refilled ~50 tokens
+    assert tb.take_up_to(1000) <= 60  # never more than burst+elapsed
+
+
+def test_token_bucket_rate_update():
+    tb = TokenBucket(10.0)
+    tb.set_rate(1e6)
+    time.sleep(0.01)
+    assert tb.take_up_to(10_000) > 100  # new rate took effect
+
+
+def test_parse_shed_policy_refuses_loudly():
+    assert parse_shed_policy("drop_oldest") == "drop_oldest"
+    with pytest.raises(WindFlowError, match="unknown shed policy"):
+        parse_shed_policy("drop_sometimes")
+
+
+# ---------------------------------------------------------------------------
+# admission gate policies
+# ---------------------------------------------------------------------------
+def _fake_replica():
+    return types.SimpleNamespace(op=types.SimpleNamespace(name="src"),
+                                 idx=0, stats=StatsRecord("src", 0))
+
+
+def _drained_gate(policy, priority_fn=None, shed_log=None, buffer_cap=4):
+    """Gate whose bucket never grants (deterministic shed behavior)."""
+    gate = AdmissionGate(_fake_replica(), policy, 0.0,
+                         priority_fn=priority_fn, shed_log=shed_log,
+                         buffer_cap=buffer_cap)
+    gate.bucket.rate = 0.0
+    gate.bucket.burst = 0.0
+    gate.bucket._tokens = 0.0
+    return gate
+
+
+def test_gate_drop_newest_sheds_incoming():
+    gate = _drained_gate("drop_newest")
+    for v in range(5):
+        assert gate.offer({"v": v}, v) == []
+    st = gate.replica.stats
+    assert st.shed_records == 5
+    assert st.shed_bytes > 0
+    assert gate.pending == 0  # tail-drop buffers nothing
+
+
+def test_gate_drop_oldest_evicts_buffer_head():
+    gate = _drained_gate("drop_oldest", buffer_cap=3)
+    for v in range(5):
+        assert gate.offer({"v": v}, v) == []
+    # buffer keeps the NEWEST 3; the two oldest shed
+    assert [p["v"] for p, _ in gate._pending] == [2, 3, 4]
+    assert gate.replica.stats.shed_records == 2
+
+
+def test_gate_key_priority_evicts_lowest_priority():
+    gate = _drained_gate("key_priority", priority_fn=lambda p: p["prio"],
+                         buffer_cap=3)
+    prios = [5, 1, 9, 3, 7]
+    for i, pr in enumerate(prios):
+        gate.offer({"v": i, "prio": pr}, i)
+    # the two lowest priorities (1, 3) shed; FIFO order preserved
+    assert [p["prio"] for p, _ in gate._pending] == [5, 9, 7]
+    assert gate.replica.stats.shed_records == 2
+
+
+def test_gate_key_priority_requires_priority_fn():
+    with pytest.raises(WindFlowError, match="with_priority"):
+        AdmissionGate(_fake_replica(), "key_priority", 100.0)
+
+
+def test_gate_probabilistic_sheds_fraction():
+    gate = AdmissionGate(_fake_replica(), "probabilistic", 50.0)
+    admitted = 0
+    for v in range(3000):  # tight loop: offered EWMA >> rate
+        admitted += len(gate.offer({"v": v}, v))
+    st = gate.replica.stats
+    assert admitted + st.shed_records == 3000
+    assert st.shed_records > 2000  # the vast majority sheds
+
+
+def test_gate_buffered_admits_when_tokens_return():
+    gate = _drained_gate("drop_oldest", buffer_cap=8)
+    for v in range(3):
+        gate.offer({"v": v}, v)
+    gate.bucket.set_rate(1e6, burst=1e6)
+    gate.bucket._tokens = 1e6
+    out = gate.offer({"v": 3}, 3)
+    # buffered records admit FIRST, in arrival order
+    assert [p["v"] for p, _ in out] == [0, 1, 2, 3]
+    assert gate.replica.stats.shed_records == 0
+
+
+def test_gate_release_is_pass_through():
+    gate = _drained_gate("drop_oldest", buffer_cap=8)
+    gate.offer({"v": 0}, 0)
+    gate.released = True
+    out = gate.offer({"v": 1}, 1)
+    assert [p["v"] for p, _ in out] == [0, 1]
+    assert gate.pending == 0
+
+
+def test_shed_log_jsonl(tmp_path):
+    log = ShedLog("glog", dir=str(tmp_path))
+    gate = _drained_gate("drop_newest", shed_log=log)
+    for v in range(7):
+        gate.offer({"v": v}, v)
+    assert log.total == 7
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(str(tmp_path), "glog.shed.jsonl"))]
+    assert len(lines) == 7
+    assert lines[0]["operator"] == "src"
+    assert lines[0]["reason"] == "drop_newest"
+
+
+def test_gate_columns_admits_prefix():
+    import numpy as np
+    gate = AdmissionGate(_fake_replica(), "drop_newest", 1000.0)
+    gate.bucket._tokens = 10.0
+    cols = {"v": np.arange(64)}
+    ts = np.arange(64, dtype=np.int64)
+    c2, t2, n = gate.offer_columns(cols, ts)
+    assert n == 10 and len(t2) == 10 and len(c2["v"]) == 10
+    assert gate.replica.stats.shed_records == 54
+
+
+# ---------------------------------------------------------------------------
+# governor ladder policy (pure logic)
+# ---------------------------------------------------------------------------
+def _policy(**kw):
+    kw.setdefault("slo_p99_ms", 100.0)
+    kw.setdefault("interval_s", 0.1)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("breach_hysteresis", 2)
+    kw.setdefault("recover_hysteresis", 3)
+    return GovernorPolicy(**kw)
+
+
+def test_policy_requires_slo():
+    with pytest.raises(WindFlowError, match="positive SLO"):
+        GovernorPolicy(slo_p99_ms=0)
+
+
+def test_policy_breach_hysteresis_then_escalate():
+    p = _policy()
+    assert p.observe(200_000.0, 0.0, 10.0) is None  # 1st breached window
+    assert p.observe(200_000.0, 0.0, 10.1) == "escalate"
+    p.note_action(10.1, TUNE)
+    # cooldown: an immediate further breach must NOT escalate again
+    assert p.observe(200_000.0, 0.0, 10.2) is None
+    assert p.observe(200_000.0, 0.0, 10.3) is None
+    assert p.observe(200_000.0, 0.0, 11.2) == "escalate"
+
+
+def test_policy_band_holds_and_no_data_holds():
+    p = _policy()
+    assert p.observe(None, 0.0, 10.0) is None  # no samples: hold
+    # inside the hysteresis band (between recover margin and SLO): hold
+    assert p.observe(90_000.0, 0.0, 10.1) is None
+    assert p._breach_streak == 0 and p._ok_streak == 0
+
+
+def test_policy_shed_rung_regulates_and_releases():
+    p = _policy()
+    p.note_action(10.0, SHED)
+    # over the setpoint: multiplicative decrease every tick, no cooldown
+    assert p.observe(95_000.0, 500.0, 10.1) == "shed_down"
+    assert p.observe(95_000.0, 500.0, 10.2) == "shed_down"
+    # deep under: probe up
+    assert p.observe(10_000.0, 500.0, 10.3) == "shed_up"
+    # under long enough AND shed rate near zero AND cooled: release
+    assert p.observe(10_000.0, 0.0, 11.2) == "shed_up"
+    assert p.observe(10_000.0, 0.0, 11.3) == "release"
+
+
+def test_policy_release_unwinds_one_rung_per_cooldown():
+    p = _policy()
+    p.note_action(10.0, TUNE)
+    for i in range(2):
+        assert p.observe(1_000.0, 0.0, 10.1 + i / 10) is None
+    assert p.observe(1_000.0, 0.0, 11.5) == "release"
+    p.note_action(11.5, IDLE)
+    assert p.rung == IDLE
+
+
+# ---------------------------------------------------------------------------
+# autoscaler interlock (the satellite bugfix)
+# ---------------------------------------------------------------------------
+def test_autoscaler_no_scale_down_while_shedding():
+    pol = AutoscalePolicy(interval_s=0.1, cooldown_s=0.0, hysteresis=1,
+                          down_blocked_get_ms=100.0)
+    starved = {"op": {"parallelism": 4, "blocked_put_ms_per_s": 0.0,
+                      "blocked_get_ms_per_s": 5000.0, "tuples_per_s": 1.0}}
+    # without the interlock this IS a scale-down decision
+    dec = AutoscalePolicy(interval_s=0.1, cooldown_s=0.0, hysteresis=1,
+                          down_blocked_get_ms=100.0).observe(
+        dict(starved), now=10.0)
+    assert dec is not None and dec[1] == 3
+    # with the governor shedding (or cooling down): vetoed
+    assert pol.observe(dict(starved), now=10.0, shed_active=True) is None
+    # and the veto clears the down-streak (no instant decision after)
+    assert pol._down_streak == {}
+    # scale-UP is never vetoed by the interlock
+    pressured = {"op": {"parallelism": 1, "blocked_put_ms_per_s": 900.0,
+                        "blocked_get_ms_per_s": 0.0, "tuples_per_s": 1.0}}
+    up = pol.observe(pressured, now=20.0, shed_active=True)
+    assert up is not None and up[1] > 1
+
+
+def test_watchdog_stands_down_while_shedding():
+    from windflow_tpu.monitoring.flightrec import StallWatchdog
+
+    class _W:
+        name = "w0"
+
+        def is_alive(self):
+            return True
+
+        def progress_value(self):
+            return 42  # frozen: would stall without the interlock
+
+    gov = types.SimpleNamespace(shedding=True)
+    graph = types.SimpleNamespace(name="g", _workers=[_W()],
+                                  _rescaling=False, _supervising=False,
+                                  _overload_governor=gov)
+    wd = StallWatchdog(graph, stall_sec=0.01)
+    wd._check(now=10.0)
+    wd._check(now=20.0)  # frozen 10s > stall_sec, but shedding: no fire
+    assert wd.fired == []
+    gov.shedding = False
+    wd._check(now=30.0)
+    wd._check(now=40.0)  # re-armed after release: now it fires
+    assert wd.fired == ["w0"]
+
+
+def test_tune_rung_halves_and_restores_knobs():
+    """Rung 1: device dispatch depths and CPU-plane output batch sizes
+    halve on escalation and restore on release (TPU staging emitters
+    are excluded — shrinking their batch would change the bucket
+    signature and retrace)."""
+    from windflow_tpu.overload import OverloadGovernor
+    from windflow_tpu.tpu import Map_TPU_Builder
+    import numpy as np
+
+    g = PipeGraph("tune", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(lambda s: None).with_name("s")
+                 .with_output_batch_size(16).build()) \
+     .add(Map_TPU_Builder(lambda f: f).with_schema({"v": np.int32})
+          .with_name("m").build()) \
+     .add_sink(Sink_Builder(lambda t: None).with_name("k").build())
+    g._build()
+    gov = OverloadGovernor(g, GovernorPolicy(slo_p99_ms=10.0))
+    m = [op for op in g._ops if op.name == "m"][0]
+    depth0 = m.replicas[0].dispatch.depth
+    assert depth0 > 0
+    assert gov._try_tune()
+    assert m.replicas[0].dispatch.depth == depth0 // 2
+    # the source feeds a TPU stage: its staging emitter must NOT be
+    # touched (bucket signatures are sacred)
+    src_em = [op for op in g._ops if op.name == "s"][0].replicas[0].emitter
+    assert src_em.output_batch_size == 16
+    gov._restore_tuned()
+    assert m.replicas[0].dispatch.depth == depth0
+
+
+# ---------------------------------------------------------------------------
+# builder / graph plumbing
+# ---------------------------------------------------------------------------
+def test_with_slo_and_priority_plumbing():
+    op = (Source_Builder(lambda s: None).with_slo(25.0)
+          .with_priority(lambda p: p["k"]).build())
+    assert op.slo_p99_ms == 25.0
+    assert op.priority_fn({"k": 9}) == 9
+    with pytest.raises(WindFlowError):
+        Source_Builder(lambda s: None).with_slo(0)
+    with pytest.raises(WindFlowError):
+        PipeGraph("g").with_slo(-1)
+
+
+def test_key_priority_without_priority_fn_refuses_at_start():
+    g = PipeGraph("nopri", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_slo(50.0, GovernorPolicy(slo_p99_ms=50.0,
+                                    shed_policy="key_priority"))
+    g.add_source(Source_Builder(lambda s: None).with_name("s").build()) \
+     .add_sink(Sink_Builder(lambda t: None).build())
+    with pytest.raises(WindFlowError, match="key_priority"):
+        g.start()
+
+
+def test_idle_governor_is_invisible():
+    """A generous SLO: governor attached, never escalates, results and
+    accounting untouched (the off-path contract microbench gates)."""
+    seen = []
+
+    def src(shipper):
+        for v in range(20_000):
+            shipper.push({"v": v})
+
+    g = PipeGraph("idle", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_slo(60_000.0)
+    g.add_source(Source_Builder(src).with_name("s").build()) \
+     .add(Map_Builder(lambda t: {"v": t["v"] + 1}).with_name("m").build()) \
+     .add_sink(Sink_Builder(lambda t: seen.append(t) if t else None)
+               .with_name("k").build())
+    g.run()
+    assert len(seen) == 20_000
+    ov = g.get_stats()["Overload"]
+    assert ov["Overload_state_name"] == "idle"
+    assert ov["Overload_escalations"] == 0
+    assert ov["Overload_shed_records"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sustained-overload soak (the acceptance scenario)
+# ---------------------------------------------------------------------------
+def test_sustained_overload_soak_holds_slo_with_exact_accounting(tmp_path):
+    """Offered rate far over capacity with NO scale headroom: the ladder
+    must reach the shed rung, hold the post-engage p99 inside the SLO,
+    keep queues off their high-water saturation, account every record
+    (offered == admitted + shed == shed-log lines + admitted), and keep
+    the exactly-once committed output byte-identical to a no-overload
+    run over the admitted set."""
+    os.environ["WF_SHED_DIR"] = str(tmp_path / "shed")
+    try:
+        qlen = []
+        p99s = []  # governor's windowed receipt-time p99, post-engage
+        t0g = [0.0]
+        pushed = [0]
+        CAP = 128
+
+        def src(shipper):
+            t0g[0] = time.monotonic()
+            i = 0
+            while time.monotonic() - t0g[0] < 5.0:
+                shipper.push({"v": i, "t0": time.perf_counter()})
+                i += 1
+                if i % 20 == 0:
+                    time.sleep(0.001)  # ~20k/s offered
+            pushed[0] = i
+
+        def work(t):
+            time.sleep(0.0005)  # ~1.5k/s capacity, parallelism 1
+            return {"v": t["v"] * 3, "t0": t["t0"]}
+
+        committed = []
+
+        def sink(t):
+            # NB: an exactly-once functor runs at COMMIT time (epoch
+            # cadence), so latency is NOT measured here — the SLO is
+            # over sink RECEIPT, which the governor's windowed e2e
+            # histograms already read
+            if t is not None:
+                committed.append(t["v"])
+
+        g = PipeGraph("soak", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME, channel_capacity=CAP)
+        g.with_checkpointing(store_dir=str(tmp_path / "ckpt"),
+                             interval=1.0)
+        g.with_slo(50.0, GovernorPolicy(
+            slo_p99_ms=50.0, interval_s=0.2, cooldown_s=0.4,
+            breach_hysteresis=2, max_parallelism=1))  # no headroom
+        g.add_source(Source_Builder(src).with_name("s").build()) \
+         .add(Map_Builder(work).with_name("hot").build()) \
+         .add_sink(Sink_Builder(sink).with_name("k")
+                   .with_exactly_once(staging_dir=str(tmp_path / "txn"))
+                   .build())
+        g.start()
+        hot = [op for op in g._ops if op.name == "hot"][0]
+        while not g._ended and t0g[0] == 0.0:
+            time.sleep(0.01)
+        stop = threading.Event()
+
+        def watch():  # queue + windowed-p99 high-water post-engage
+            gov = g._overload_governor
+            while not stop.is_set():
+                if time.monotonic() - t0g[0] >= 3.0:
+                    ch = hot.replicas[0].stats.input_channel
+                    if ch is not None:
+                        qlen.append(len(ch))
+                    p99s.append(gov.window_p99_us)
+                time.sleep(0.05)
+
+        w = threading.Thread(target=watch, daemon=True)
+        w.start()
+        g.wait_end()
+        stop.set()
+        w.join(timeout=2)
+
+        st = g.get_stats()
+        ov = st["Overload"]
+        src_rep = [r for o in st["Operators"] if o["name"] == "s"
+                   for r in o["replicas"]][0]
+        admitted, shed = src_rep["Inputs_received"], src_rep["Shed_records"]
+        # the ladder reached shed (tune was a no-op, scale had no room)
+        assert ov["Overload_state_name"] == "shed"
+        assert shed > 0 and src_rep["Shed_bytes"] > 0
+        # EXACT accounting: every offered record is admitted or shed
+        assert admitted + shed == pushed[0]
+        # ...and every shed is in the audit log
+        log_lines = sum(1 for _ in open(
+            os.path.join(str(tmp_path / "shed"), "soak.shed.jsonl")))
+        assert log_lines == shed
+        # post-engage windowed p99 inside the SLO throughout (the
+        # pegged no-governor equivalent sits at CAP * svc ~ 85ms)
+        assert p99s, "no post-engage p99 observations"
+        assert max(p99s) < 50_000.0, \
+            f"windowed p99 {max(p99s) / 1e3:.1f}ms breaches the SLO"
+        # queues stay OFF saturation once admission control runs
+        assert qlen and max(qlen) < CAP, \
+            f"hot input queue saturated post-engage: {max(qlen)}/{CAP}"
+        # exactly-once over the admitted set: committed output ==
+        # functor outputs, and a governor-less rerun over exactly the
+        # admitted inputs is byte-identical
+        from windflow_tpu.sinks.transactional import read_committed_records
+        segs = [r["v"] for r, _ in read_committed_records(
+            os.path.join(str(tmp_path / "txn"), "k_r0"))]
+        assert segs == committed
+        admitted_inputs = [v // 3 for v in committed]
+
+        def replay_src(shipper):
+            for v in admitted_inputs:
+                shipper.push({"v": v, "t0": time.perf_counter()})
+
+        replay_out = []
+        g2 = PipeGraph("soak_replay", ExecutionMode.DEFAULT,
+                       TimePolicy.INGRESS_TIME, channel_capacity=CAP)
+        g2.with_checkpointing(store_dir=str(tmp_path / "ckpt2"))
+        g2.add_source(Source_Builder(replay_src).with_name("s").build()) \
+          .add(Map_Builder(lambda t: {"v": t["v"] * 3, "t0": t["t0"]})
+               .with_name("hot").build()) \
+          .add_sink(Sink_Builder(lambda t: replay_out.append(t["v"])
+                                 if t else None).with_name("k")
+                    .with_exactly_once(
+                        staging_dir=str(tmp_path / "txn2")).build())
+        g2.run()
+        segs2 = [r["v"] for r, _ in read_committed_records(
+            os.path.join(str(tmp_path / "txn2"), "k_r0"))]
+        assert segs2 == segs, "admitted-set output not byte-identical"
+    finally:
+        os.environ.pop("WF_SHED_DIR", None)
+
+
+# ---------------------------------------------------------------------------
+# compile-stability pre-warm (ROADMAP item)
+# ---------------------------------------------------------------------------
+def _ragged_columns_source(n_pushes=40, max_n=64, seed=3):
+    import numpy as np
+
+    def src(shipper):
+        rng = np.random.default_rng(seed)
+        for _ in range(n_pushes):
+            n = int(rng.integers(1, max_n + 1))
+            shipper.push_columns(
+                {"key": rng.integers(0, 8, n).astype(np.int32),
+                 "value": rng.integers(0, 100, n).astype(np.int32)})
+
+    return src
+
+
+def test_prewarm_ragged_soak_compile_count_flat():
+    """Ragged columnar pushes land in every power-of-two bucket; with
+    with_prewarm() every signature compiles at start() and the STREAM
+    never retraces — Compile_count stays flat after warm-up."""
+    import numpy as np
+    from windflow_tpu.tpu import Filter_TPU_Builder, Map_TPU_Builder
+
+    sch = {"key": np.int32, "value": np.int32}
+    seen = [0]
+    g = PipeGraph("pw", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_prewarm()
+    g.add_source(Source_Builder(_ragged_columns_source()).with_name("s")
+                 .with_output_batch_size(64).build()) \
+     .add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 2})
+          .with_schema(sch).with_name("m").build()) \
+     .add(Filter_TPU_Builder(lambda f: f["value"] % 2 == 0)
+          .with_schema(sch).with_name("f").build()) \
+     .add_sink(Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                            if t else None).with_name("k").build())
+    g.run()
+    rep = g.prewarm_report
+    assert rep is not None and rep["signatures_compiled"] > 0
+    assert rep["bucket_caps"] == [8, 16, 32, 64]
+    assert not rep["skipped"]
+    st = g.get_stats()
+    total_compiles = sum(r.get("Compile_count", 0)
+                         for o in st["Operators"] for r in o["replicas"])
+    total_hits = sum(r.get("Compile_cache_hits", 0)
+                     for o in st["Operators"] for r in o["replicas"])
+    # flat after warm-up: every stream batch was a cache hit
+    assert total_compiles == rep["signatures_compiled"]
+    assert total_hits > 0
+    assert seen[0] > 0
+
+
+def test_prewarm_fused_chain_compile_count_flat():
+    """A chained (fused) stateless device stage pre-warms its composed
+    whole-chain program per bucket."""
+    import numpy as np
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    sch = {"key": np.int32, "value": np.int32}
+    seen = [0]
+    g = PipeGraph("pwf", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_prewarm()
+    g.add_source(Source_Builder(_ragged_columns_source(seed=9, max_n=32))
+                 .with_name("s").with_output_batch_size(32).build()) \
+     .add(Map_TPU_Builder(lambda f: {**f, "value": f["value"] + 1})
+          .with_schema(sch).with_name("m1").build()) \
+     .chain(Map_TPU_Builder(lambda f: {**f, "value": f["value"] * 3})
+            .with_schema(sch).with_name("m2").build()) \
+     .add_sink(Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                            if t else None).with_name("k").build())
+    g.run()
+    rep = g.prewarm_report
+    st = g.get_stats()
+    fused = [o for o in st["Operators"] if o["kind"] == "Fused_TPU_Chain"]
+    if fused:  # fusion on (the default): the chain warmed as ONE program
+        assert rep["signatures_compiled"] == len(rep["bucket_caps"])
+        total_compiles = sum(r.get("Compile_count", 0)
+                             for o in st["Operators"]
+                             for r in o["replicas"])
+        assert total_compiles == rep["signatures_compiled"]
+    assert seen[0] > 0
+
+
+def test_prewarm_skips_inferred_schema_and_cpu_graphs():
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    # device op WITHOUT a declared schema: skipped, named in the report
+    g = PipeGraph("pwskip", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_prewarm()
+    g.add_source(Source_Builder(_ragged_columns_source(n_pushes=4))
+                 .with_name("s").with_output_batch_size(16).build()) \
+     .add(Map_TPU_Builder(lambda f: f).with_name("m").build()) \
+     .add_sink(Sink_Builder(lambda t: None).build())
+    g.run()
+    rep = g.prewarm_report
+    assert rep["signatures_compiled"] == 0
+    assert any("m" in s or "schema" in s for s in rep["skipped"])
+    # pure CPU graph: prewarm is a no-op, not an error
+    g2 = PipeGraph("pwcpu", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g2.with_prewarm()
+    g2.add_source(Source_Builder(
+        lambda s: [s.push({"v": i}) for i in range(10)])
+        .with_name("s").build()) \
+      .add_sink(Sink_Builder(lambda t: None).build())
+    g2.run()
+    assert g2.prewarm_report["skipped"] == ["no device stages"]

@@ -35,6 +35,7 @@ from .operators.window_engine import WinResult
 from .operators.windows import (Keyed_Windows, MapReduce_Windows,
                                 Paned_Windows, Parallel_Windows)
 from .operators.source import Source, SourceShipper
+from .overload import GovernorPolicy, ShedLog, TokenBucket
 from .scaling.autoscaler import AutoscalePolicy
 from .sinks.transactional import FencedWriteError
 from .supervision import (DeadLetterQueue, ErrorPolicy, RestartPolicy,
@@ -60,6 +61,7 @@ __all__ = [
     "Paned_Windows_Builder", "MapReduce_Windows_Builder",
     "Ffat_Windows_Builder", "Interval_Join", "Interval_Join_Builder",
     "AutoscalePolicy",
+    "GovernorPolicy", "TokenBucket", "ShedLog",
     "RestartPolicy", "ErrorPolicy", "DeadLetterQueue",
     "SupervisionEscalated",
     "__version__",

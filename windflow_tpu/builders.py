@@ -146,12 +146,49 @@ class _RoutableBuilder(BasicBuilder):
         return self
 
 
-class Source_Builder(BasicBuilder):
+class _SourceOverloadMixin:
+    """``with_slo`` / ``with_priority`` for source builders — the
+    overload-protection surface (``windflow_tpu.overload``). Shared with
+    the Kafka source builder."""
+
+    _slo_p99_ms: Optional[float] = None
+    _priority_fn: Optional[Callable] = None
+
+    def with_slo(self, p99_ms: float):
+        """Declare this source's end-to-end p99 latency budget
+        (milliseconds). Attaches the overload governor to the graph at
+        ``start()``; with several declared budgets (graph-level
+        ``PipeGraph.with_slo`` and/or other sources) the TIGHTEST one
+        governs. Env twin (graph-wide): ``WF_SLO_P99_MS``."""
+        if p99_ms <= 0:
+            raise WindFlowError("with_slo: p99_ms must be > 0")
+        self._slo_p99_ms = float(p99_ms)
+        return self
+
+    def with_priority(self, fn: Callable[[Any], Any]):
+        """Record-priority extractor (higher = more important) for the
+        ``key_priority`` shed policy: when the governor's admission gate
+        must evict, the LOWEST-priority buffered record sheds — so (for
+        a Zipf workload) head keys survive an overload that drops the
+        tail. Ignored by the other shed policies."""
+        if not callable(fn):
+            raise WindFlowError("with_priority: fn must be callable")
+        self._priority_fn = fn
+        return self
+
+    def _finish_overload(self, op):
+        op.slo_p99_ms = self._slo_p99_ms
+        op.priority_fn = self._priority_fn
+        return op
+
+
+class Source_Builder(_SourceOverloadMixin, BasicBuilder):
     _default_name = "source"
 
     def build(self) -> Source:
-        return self._finish(Source(self._func, self._name, self._parallelism,
-                                   self._output_batch_size))
+        return self._finish_overload(self._finish(
+            Source(self._func, self._name, self._parallelism,
+                   self._output_batch_size)))
 
 
 class Map_Builder(_RoutableBuilder):

@@ -6,11 +6,11 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..basic import WindFlowError
-from ..builders import BasicBuilder
+from ..builders import BasicBuilder, _SourceOverloadMixin
 from .connectors import Kafka_Sink, Kafka_Source
 
 
-class Kafka_Source_Builder(BasicBuilder):
+class Kafka_Source_Builder(_SourceOverloadMixin, BasicBuilder):
     _default_name = "kafka_source"
 
     def __init__(self, deser_func: Callable) -> None:
@@ -48,10 +48,10 @@ class Kafka_Source_Builder(BasicBuilder):
             raise WindFlowError("Kafka_Source_Builder: withBrokers mandatory")
         if not self._topics:
             raise WindFlowError("Kafka_Source_Builder: withTopics mandatory")
-        return self._finish(Kafka_Source(
+        return self._finish_overload(self._finish(Kafka_Source(
             self._func, self._brokers, self._topics, self._group_id,
             self._offsets, self._idleness_ms, self._name, self._parallelism,
-            self._output_batch_size))
+            self._output_batch_size)))
 
 
 class Kafka_Sink_Builder(BasicBuilder):

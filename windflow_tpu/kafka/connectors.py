@@ -684,6 +684,11 @@ class KafkaSourceReplica(BasicReplica):
         self._pending_commits: Dict[int, Dict[Tuple[str, int], int]] = {}
         self._commit_ready = 0
         self._committed = 0
+        # overload admission control (windflow_tpu.overload): installed
+        # by the governor while shedding, same contract as
+        # SourceReplica._gate (shed before emit; a shed Kafka record's
+        # offset is already consumed, so it never replays)
+        self._gate = None
 
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Kafka_Source has no input")
@@ -745,6 +750,10 @@ class KafkaSourceReplica(BasicReplica):
         if self._transport is not None:
             # keys are (topic, partition) tuples — pickle keeps them
             st["offsets"] = self._transport.snapshot_positions()
+        # shed accounting rides the snapshot (same contract as
+        # SourceReplica): restore must not zero permanent drops
+        st["shed_records"] = self.stats.shed_records
+        st["shed_bytes"] = self.stats.shed_bytes
         return st
 
     def restore_state(self, state: dict) -> None:
@@ -752,6 +761,8 @@ class KafkaSourceReplica(BasicReplica):
         offs = state.get("offsets")
         if offs is not None:
             self._restore_offsets = dict(offs)
+        self.stats.shed_records = state.get("shed_records", 0)
+        self.stats.shed_bytes = state.get("shed_bytes", 0)
 
     def run_source(self) -> None:
         op = self.op
@@ -814,6 +825,16 @@ class KafkaSourceReplica(BasicReplica):
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         if wm > self.cur_wm:
             self.cur_wm = wm
+        gate = self._gate
+        if gate is not None:
+            for p, t in gate.offer(payload, ts):
+                self._emit_admitted(p, t)
+            if gate.released and not gate.pending:
+                self._gate = None
+            return
+        self._emit_admitted(payload, ts)
+
+    def _emit_admitted(self, payload: Any, ts: int) -> None:
         st = self.stats
         st.inputs_received += 1
         # sampled latency tracing, same mask gate as SourceReplica.ship

@@ -337,6 +337,14 @@ class StallWatchdog(threading.Thread):
             # re-arm from scratch once the new plane is running
             self._seen.clear()
             return
+        gov = getattr(self.graph, "_overload_governor", None)
+        if gov is not None and gov.shedding:
+            # active load shedding: a fully gated source emits nothing
+            # BY DESIGN (and its downstream can legitimately go quiet) —
+            # flagging that as a stall would dump postmortems during
+            # every overload; re-arm once admission control releases
+            self._seen.clear()
+            return
         for w in self.graph._workers:
             if not w.is_alive():
                 self._seen.pop(w.name, None)

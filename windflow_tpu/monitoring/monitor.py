@@ -194,6 +194,12 @@ _PROM_SCALARS = (
     ("windflow_kafka_reconnects_total", "counter",
      "Kafka transient-error retries/reconnects (connect/produce/consume)",
      "Kafka_reconnects", 1),
+    ("windflow_shed_records_total", "counter",
+     "Records shed by source admission control (overload governor)",
+     "Shed_records", 1),
+    ("windflow_shed_bytes_total", "counter",
+     "Approximate bytes shed by source admission control",
+     "Shed_bytes", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -339,6 +345,46 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             if not isinstance(st, dict):
                 continue
             v = (st.get("Supervision") or {}).get(field)
+            if isinstance(v, (int, float)):
+                body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
+                            f'{v * scale:g}')
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
+    # overload-protection plane (windflow_tpu.overload): governor state
+    # (0=idle 1=tune 2=scale 3=shed — alert on state==3 sustained),
+    # escalation counters and the admitted-vs-offered rates that define
+    # the shed fraction during an overload
+    _OVERLOAD_FAMS = (
+        ("windflow_overload_state", "gauge",
+         "Overload-governor escalation rung (0=idle 1=tune 2=scale "
+         "3=shed)", "Overload_state", 1),
+        ("windflow_overload_escalations_total", "counter",
+         "Overload-governor ladder escalations", "Overload_escalations", 1),
+        ("windflow_overload_releases_total", "counter",
+         "Overload-governor recovery releases (one rung down)",
+         "Overload_releases", 1),
+        ("windflow_overload_window_p99_seconds", "gauge",
+         "Windowed sink-side e2e p99 the governor acted on last",
+         "Overload_window_p99_usec", 1e-6),
+        ("windflow_overload_slo_p99_seconds", "gauge",
+         "Declared end-to-end p99 SLO", "Overload_slo_p99_usec", 1e-6),
+        ("windflow_overload_admit_rate_tuples_per_second", "gauge",
+         "Token-bucket admit rate while shedding (0 = not shedding)",
+         "Overload_admit_rate_tps", 1),
+        ("windflow_overload_offered_tuples_per_second", "gauge",
+         "Offered rate at the sources (admitted + shed) last window",
+         "Overload_offered_tps", 1),
+        ("windflow_overload_shed_tuples_per_second", "gauge",
+         "Shed rate last window", "Overload_shed_tps", 1),
+    )
+    for fam, typ, help_, field, scale in _OVERLOAD_FAMS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            v = (st.get("Overload") or {}).get(field)
             if isinstance(v, (int, float)):
                 body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
                             f'{v * scale:g}')

@@ -50,6 +50,10 @@ class StatsRecord:
         # policy-skipped records, retry attempts; and Kafka transient-
         # error reconnect/retry events (kafka/connectors.py)
         "dlq_records", "dlq_skipped", "dlq_retries", "kafka_reconnects",
+        # overload protection (windflow_tpu.overload): records/bytes shed
+        # by admission control at the SOURCE boundary (before barriers
+        # and the exactly-once plane — accounted, never silently lost)
+        "shed_records", "shed_bytes",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -122,6 +126,8 @@ class StatsRecord:
         self.dlq_skipped = 0
         self.dlq_retries = 0
         self.kafka_reconnects = 0
+        self.shed_records = 0
+        self.shed_bytes = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -248,6 +254,13 @@ class StatsRecord:
         self.compile_last_us = us
         self.compile_last_signature = signature
 
+    # -- overload protection (windflow_tpu.overload) --------------------------
+    def note_shed(self, n: int, nbytes: int) -> None:
+        """Records shed by source admission control (never emitted, so
+        they appear in NO other counter — offered = admitted + shed)."""
+        self.shed_records += n
+        self.shed_bytes += nbytes
+
     # -- latency tracing -----------------------------------------------------
     def note_e2e(self, us: float) -> None:
         """End-to-end latency of one traced tuple (sink side)."""
@@ -318,6 +331,9 @@ class StatsRecord:
             "Dlq_retries": self.dlq_retries,
             # Kafka transient-error retry/backoff (kafka/connectors.py)
             "Kafka_reconnects": self.kafka_reconnects,
+            # overload admission control (0s unless the governor sheds)
+            "Shed_records": self.shed_records,
+            "Shed_bytes": self.shed_bytes,
             # worker crash visibility (Worker records on its error path)
             "Worker_crashes": self.worker_crashes,
             "Worker_last_error": self.worker_last_error,

@@ -166,6 +166,19 @@ function render(snap){
   const dlq = st.Dead_letters|0;
   if (dlq) el("badges").innerHTML +=
     `<span class="badge warn">dead letters ${fmt(dlq)}</span>`;
+  // overload-governor badge: ladder state + shed accounting (warn
+  // style while actively shedding — the graph is refusing work to
+  // hold its latency SLO)
+  const ov = (st.Overload||{});
+  if (ov.Overload_state_name && (ov.Overload_state|0) > 0
+      || (ov.Overload_shed_records|0) > 0)
+    el("badges").innerHTML +=
+      `<span class="badge ${ov.Overload_shedding?'warn':''}">`+
+      `overload: ${esc(ov.Overload_state_name||"?")}`+
+      (ov.Overload_shedding
+        ? ` (admit ${fmt(ov.Overload_admit_rate_tps)}/s)` : "")+
+      ((ov.Overload_shed_records|0) > 0
+        ? ` — shed ${fmt(ov.Overload_shed_records)}` : "")+`</span>`;
   sparkLine("sparklat", lhist[current], "#b0452b", "µs", rmark[current]);
   const svg = (snap.svgs||{})[current];  // server-sanitized
   el("diagram").innerHTML = "<summary>dataflow graph</summary>"+

@@ -129,6 +129,13 @@ class SourceReplica(BasicReplica):
         self._inject_cb = None  # Worker.checkpoint_now (chain-wide)
         self._last_ckpt = 0
         self._restore_position = None
+        # overload admission control (windflow_tpu.overload): the
+        # governor installs an AdmissionGate here while shedding; the
+        # default hot path pays one is-None check per push. Shedding
+        # happens HERE — before the emitter, the barriers and the
+        # exactly-once plane — so shed records never enter a channel,
+        # a snapshot or a sink transaction.
+        self._gate = None
 
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Source has no input")
@@ -170,6 +177,10 @@ class SourceReplica(BasicReplica):
         cursor gives exact resume; coarser cursors give at-least-once."""
         st = super().snapshot_state()
         st["shipped"] = self.stats.inputs_received
+        # shed accounting rides the snapshot: a restore/rescale must not
+        # zero counters for records that are gone for good
+        st["shed_records"] = self.stats.shed_records
+        st["shed_bytes"] = self.stats.shed_bytes
         snap = getattr(self.op.func, "snapshot_position", None)
         if snap is not None:
             st["position"] = (snap(self.context) if arity(snap) >= 1
@@ -180,6 +191,8 @@ class SourceReplica(BasicReplica):
         super().restore_state(state)
         self._restore_position = state.get("position")
         self.stats.inputs_received = state.get("shipped", 0)
+        self.stats.shed_records = state.get("shed_records", 0)
+        self.stats.shed_bytes = state.get("shed_bytes", 0)
 
     def run_source(self) -> None:
         """Run the user generation loop to completion (then the worker
@@ -200,6 +213,13 @@ class SourceReplica(BasicReplica):
             self.op.func(shipper, self.context)
         else:
             self.op.func(shipper)
+        gate = self._gate
+        if gate is not None and gate.pending:
+            # end-of-stream with records still buffered in the admission
+            # gate: they were ACCEPTED (only awaiting tokens) — emit them
+            # rather than silently dropping accepted data at EOS
+            for p, t in gate.drain_pending():
+                self._emit_admitted(p, t)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         # barrier BEFORE the tuple: the functor's cursor has not advanced
@@ -211,6 +231,16 @@ class SourceReplica(BasicReplica):
             self._maybe_inject()
         if wm > self.cur_wm:
             self.cur_wm = wm
+        gate = self._gate
+        if gate is not None:
+            for p, t in gate.offer(payload, ts):
+                self._emit_admitted(p, t)
+            if gate.released and not gate.pending:
+                self._gate = None  # recovery: back to the ungated path
+            return
+        self._emit_admitted(payload, ts)
+
+    def _emit_admitted(self, payload: Any, ts: int) -> None:
         st = self.stats
         st.inputs_received += 1
         if not (st.inputs_received & self._trace_mask):
@@ -223,6 +253,14 @@ class SourceReplica(BasicReplica):
             self._maybe_inject()  # before the push, like ship()
         if wm > self.cur_wm:
             self.cur_wm = wm
+        gate = self._gate
+        if gate is not None:
+            if gate.released:
+                self._gate = None  # columnar gates buffer nothing
+            else:
+                cols, ts_arr, n = gate.offer_columns(cols, ts_arr)
+                if n == 0:
+                    return
         self.stats.inputs_received += len(ts_arr)
         if self.stats.sample_every:
             # columnar pushes sample at push granularity (one stamp per

@@ -1,0 +1,41 @@
+"""Overload-protection plane: SLO-driven admission control, priority
+load shedding, and graceful degradation past the autoscaler's MAX_PAR.
+
+Blocking backpressure (bounded channels) and elastic scale-out
+(``windflow_tpu.scaling``) bound latency only while parallelism headroom
+exists: at ``MAX_PAR`` a static answer to offered load is unbounded
+queueing delay. This package closes that gap with an
+:class:`OverloadGovernor` control loop that consumes the signals the
+observability plane already exports (queue backpressure gauges, sink-side
+end-to-end latency histograms, autoscaler state) and walks an escalation
+ladder when a user-declared SLO (``PipeGraph.with_slo(p99_ms)``) is
+breached:
+
+1. **TUNE** — shrink the device-ahead dispatch depth and source-side
+   output batching (latency for throughput);
+2. **SCALE** — delegate to the elastic plane (scale the bottleneck
+   operator, bounded by MAX_PAR);
+3. **SHED** — switch sources from blocking to admission-controlled
+   ingestion: a token bucket rate-limits admits and a pluggable policy
+   (``drop_newest`` / ``drop_oldest`` / ``probabilistic`` /
+   ``key_priority``) picks what to shed — at SOURCE admission, before
+   checkpoint barriers and the exactly-once plane, so delivery stays
+   byte-identical over the admitted records;
+
+then recovers with hysteresis and cooldown (AIMD on the admit rate, one
+rung at a time back down the ladder). Every shed is accounted:
+``Shed_records``/``Shed_bytes`` stats, ``windflow_shed_*`` and
+``windflow_overload_*`` metric families, ``shed:*``/``overload:*``
+flight-recorder spans, and an optional ``WF_SHED_DIR`` JSONL audit log
+(the dead-letter writer's machinery).
+"""
+
+from .admission import (SHED_POLICIES, AdmissionGate, ShedLog, TokenBucket,
+                        parse_shed_policy)
+from .governor import SLO_STATES, GovernorPolicy, OverloadGovernor
+
+__all__ = [
+    "AdmissionGate", "TokenBucket", "ShedLog", "SHED_POLICIES",
+    "parse_shed_policy", "GovernorPolicy", "OverloadGovernor",
+    "SLO_STATES",
+]

@@ -174,7 +174,10 @@ class Worker(threading.Thread):
         v = self._progress
         stats = self._stats()
         if stats is not None:
-            v += stats.inputs_received + stats.outputs_sent
+            # shed records count as progress: a source under admission
+            # control is actively REFUSING work, not wedged
+            v += (stats.inputs_received + stats.outputs_sent
+                  + stats.shed_records)
         return v
 
     # -- normal path -------------------------------------------------------

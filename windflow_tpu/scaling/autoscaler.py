@@ -92,12 +92,18 @@ class AutoscalePolicy:
         self._up_streak.clear()
         self._down_streak.clear()
 
-    def observe(self, rates: Dict[str, Dict[str, float]], now: float
+    def observe(self, rates: Dict[str, Dict[str, float]], now: float,
+                shed_active: bool = False
                 ) -> Optional[Tuple[str, int, str]]:
         """One decision step. ``rates`` maps eligible operator name ->
         ``{"parallelism", "blocked_put_ms_per_s", "blocked_get_ms_per_s",
         "tuples_per_s"}`` (rates already normalized per wall second).
-        Returns ``(op, new_parallelism, reason)`` or None."""
+        ``shed_active``: the overload governor is shedding (or inside
+        its cooldown) — scale-DOWN is vetoed, because a post-surge lull
+        under admission control reads as starvation while the dropped
+        load is exactly what the current capacity absorbs; draining a
+        replica then re-adding it on the next breach flaps. Returns
+        ``(op, new_parallelism, reason)`` or None."""
         if now - self._last_action_t < self.cooldown_s:
             return None
         # scale UP the worst backpressured operator first: congestion
@@ -122,7 +128,11 @@ class AutoscalePolicy:
                         f">= {self.up_blocked_put_ms:.0f}ms/s "
                         f"for {self._up_streak[worst]} windows")
         # scale DOWN a starved operator (never while anything is
-        # backpressured — draining capacity under load oscillates)
+        # backpressured — draining capacity under load oscillates — and
+        # never while the overload governor sheds or cools down)
+        if shed_active:
+            self._down_streak.clear()
+            return None
         if worst is None:
             for name, m in sorted(rates.items()):
                 par = int(m["parallelism"])
@@ -231,7 +241,9 @@ class Autoscaler(threading.Thread):
             return
         now = time.monotonic()
         rates = self._rates(self._totals(), now)
-        decision = self.policy.observe(rates, now)
+        gov = getattr(g, "_overload_governor", None)
+        shed_active = gov is not None and gov.blocks_scale_down(now)
+        decision = self.policy.observe(rates, now, shed_active=shed_active)
         if decision is None:
             return
         op, new_par, reason = decision

@@ -136,7 +136,15 @@ class DeadLetterQueue:
 
     The in-memory ring additionally keeps the live payload OBJECT under
     ``"payload_obj"`` for same-process inspection/re-injection.
+
+    The bounded-ring + JSONL-stream machinery is reusable: subclasses
+    override ``_suffix``/``_env_dir`` and feed ``put_raw`` their own
+    record schema (the overload plane's shed audit log,
+    ``windflow_tpu.overload.admission.ShedLog``, does exactly that).
     """
+
+    _suffix = ".dlq.jsonl"
+    _env_dir = "WF_DLQ_DIR"
 
     def __init__(self, graph_name: str = "pipegraph", capacity: int = 10_000,
                  dir: Optional[str] = None) -> None:
@@ -145,12 +153,24 @@ class DeadLetterQueue:
         self._ring: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self.total = 0  # ever quarantined (the ring may have evicted)
-        self._dir = dir if dir is not None else os.environ.get("WF_DLQ_DIR")
+        self._dir = dir if dir is not None else os.environ.get(self._env_dir)
         self._path: Optional[str] = None
         if self._dir:
             safe = "".join(c if c.isalnum() or c in "-_." else "_"
                            for c in graph_name) or "pipegraph"
-            self._path = os.path.join(self._dir, f"{safe}.dlq.jsonl")
+            self._path = os.path.join(self._dir, f"{safe}{self._suffix}")
+
+    def put_raw(self, rec: Dict[str, Any],
+                ring_extra: Optional[Dict[str, Any]] = None) -> None:
+        """Append one pre-composed record: ring (plus ``ring_extra``
+        in-memory-only keys) and, when a directory is configured, the
+        JSONL stream."""
+        with self._lock:
+            self.total += 1
+            self._ring.append(rec if ring_extra is None
+                              else {**rec, **ring_extra})
+            if self._path is not None:
+                self._append_jsonl(rec)
 
     def put(self, operator: str, replica: int, payload: Any, ts: int,
             exc: BaseException) -> Dict[str, Any]:
@@ -164,11 +184,7 @@ class DeadLetterQueue:
                 type(exc), exc, exc.__traceback__)),
             "wall_time": time.time(),
         }
-        with self._lock:
-            self.total += 1
-            self._ring.append({**rec, "payload_obj": payload})
-            if self._path is not None:
-                self._append_jsonl(rec)
+        self.put_raw(rec, ring_extra={"payload_obj": payload})
         return rec
 
     def _append_jsonl(self, rec: Dict[str, Any]) -> None:

@@ -296,6 +296,12 @@ class Supervisor(threading.Thread):
                     drv.restore({"txn_last_epoch": 0})
 
     # -- cumulative-counter carryover (dashboards must not zero out) -------
+    # NOT here: shed_records/shed_bytes. They ride the SOURCE's
+    # checkpoint snapshot instead (SourceReplica.snapshot_state), which
+    # keeps them aligned with the rewound replay cursor — additive
+    # carryover on top would double-count every shed in the replayed
+    # segment (offered == admitted + shed must hold exactly across a
+    # restart).
     _CARRY_FIELDS = ("worker_crashes", "dlq_records", "dlq_skipped",
                      "dlq_retries", "kafka_reconnects")
 

@@ -98,6 +98,24 @@ class PipeGraph:
         # compiled chain programs instead of re-tracing from scratch
         self._compile_cache_dir: Optional[str] = \
             os.environ.get("WF_COMPILE_CACHE_DIR") or None
+        # overload protection (windflow_tpu.overload): with_slo(p99_ms)
+        # or WF_SLO_P99_MS attach an OverloadGovernor control loop at
+        # start() — SLO-breach escalation (tune -> scale -> shed) with
+        # hysteresis/cooldown recovery
+        self._slo_p99_ms: Optional[float] = None
+        self._overload_policy = None
+        self._overload_governor = None
+        env_slo = os.environ.get("WF_SLO_P99_MS")
+        if env_slo:
+            try:
+                self._slo_p99_ms = float(env_slo)
+            except ValueError:
+                pass  # malformed knob must not take down the graph
+        # compile-stability pre-warm (with_prewarm / WF_PREWARM=1):
+        # compile every bucketed device-chain signature at start(),
+        # before the sources open, so no retrace lands mid-stream
+        self._prewarm_enabled = env_flag("WF_PREWARM")
+        self._prewarm_report: Optional[Dict[str, Any]] = None
         env_iv = os.environ.get("WF_CKPT_INTERVAL")
         if env_iv:
             try:
@@ -122,6 +140,156 @@ class PipeGraph:
             raise WindFlowError("with_exactly_once after start()")
         self._exactly_once = True
         return self
+
+    # ------------------------------------------------------------------
+    # overload protection (windflow_tpu.overload)
+    # ------------------------------------------------------------------
+    def with_slo(self, p99_ms: float, policy: Optional[Any] = None
+                 ) -> "PipeGraph":
+        """Declare the graph's end-to-end p99 latency budget
+        (milliseconds) and attach the :class:`OverloadGovernor` at
+        ``start()``: when the sink-side windowed p99 breaches the SLO the
+        governor walks an escalation ladder — shrink dispatch
+        depth/output batching, scale the bottleneck operator (bounded by
+        MAX_PAR), then admission-control the sources (token-bucket rate
+        limiting + the configured shed policy) — and recovers with
+        hysteresis and cooldown. ``policy`` is a
+        :class:`GovernorPolicy` (None = defaults, tunable via the
+        ``WF_SLO_*`` / ``WF_SHED_*`` env knobs). Per-source budgets via
+        ``Source_Builder.with_slo``; the tightest declared budget
+        governs. Sink-side latency sampling is enabled automatically
+        (1/16) when not already configured — the governor is blind
+        without e2e samples. Env twin: ``WF_SLO_P99_MS``."""
+        if self._started:
+            raise WindFlowError("with_slo after start()")
+        if p99_ms <= 0:
+            raise WindFlowError("with_slo: p99_ms must be > 0")
+        self._slo_p99_ms = float(p99_ms)
+        self._overload_policy = policy
+        return self
+
+    def _effective_slo_ms(self) -> Optional[float]:
+        """Tightest declared budget: graph-level with_slo/WF_SLO_P99_MS
+        and every source builder's with_slo."""
+        budgets = [self._slo_p99_ms] if self._slo_p99_ms else []
+        budgets += [op.slo_p99_ms for op in self._ops
+                    if getattr(op, "slo_p99_ms", None)]
+        return min(budgets) if budgets else None
+
+    def _setup_overload_governor(self) -> None:
+        """Create the governor (started with the other control threads).
+        Validation is LOUD and up-front: a key_priority shed policy
+        without priorities would only fail mid-surge otherwise."""
+        slo_ms = self._effective_slo_ms()
+        if slo_ms is None and self._overload_policy is None:
+            return
+        from ..overload import GovernorPolicy, OverloadGovernor
+        policy = self._overload_policy
+        if policy is None:
+            policy = GovernorPolicy(slo_p99_ms=slo_ms)
+        elif slo_ms is not None and slo_ms * 1e3 < policy.slo_us:
+            policy.slo_us = slo_ms * 1e3  # a source declared tighter
+        if policy.shed_policy == "key_priority":
+            for op in self._ops:
+                if op.op_type == OpType.SOURCE \
+                        and getattr(op, "priority_fn", None) is None:
+                    raise WindFlowError(
+                        f"with_slo: shed policy 'key_priority' needs "
+                        f"with_priority(fn) on source {op.name!r} — "
+                        "records have no priority to shed by otherwise")
+        self._overload_governor = OverloadGovernor(self, policy)
+
+    def _ensure_slo_sampling(self) -> None:
+        """BEFORE ``_build`` (replica histograms allocate at replica
+        construction): the governor needs sink-side e2e samples, so an
+        SLO declaration turns on 1/16 sampling for sinks (and 1/16
+        source stamping) when nothing configured it."""
+        if self._effective_slo_ms() is None:
+            return
+        from ..monitoring.tracing import env_sample_every
+        if env_sample_every() > 0:
+            return  # WF_LATENCY_SAMPLE already stamps the stream
+        for op in self._ops:
+            if op.op_type in (OpType.SOURCE, OpType.SINK) \
+                    and op.latency_sample is None:
+                op.latency_sample = 16
+
+    # ------------------------------------------------------------------
+    # compile-stability pre-warm (ROADMAP: kill retrace storms)
+    # ------------------------------------------------------------------
+    def with_prewarm(self) -> "PipeGraph":
+        """Pre-warm the device plane at ``start()``: every stateless
+        chain program compiles for every power-of-two bucket capacity up
+        to the graph's largest staging batch, BEFORE the sources open —
+        so a ragged stream (whose tail batches and keyed repartitions
+        land in smaller buckets) never pays a retrace mid-stream.
+        Stateful programs (grid scans, FFAT forests) key their
+        signatures on runtime cardinality and are skipped (the report
+        names them). Compiles land in ``Compile_*`` stats during
+        warm-up; ``Compile_count`` then stays flat. Results in
+        ``prewarm_report`` / ``get_stats()["Prewarm"]``. Env twin:
+        ``WF_PREWARM=1``; pairs with ``with_compile_cache`` so restarts
+        re-warm from disk in milliseconds."""
+        if self._started:
+            raise WindFlowError("with_prewarm after start()")
+        self._prewarm_enabled = True
+        return self
+
+    def _bucket_caps(self) -> List[int]:
+        """The finite bucket set a run can see: powers of two from the
+        minimum staging bucket up to the largest declared output batch
+        (ragged tails keep the full bucket; device-side keyed
+        repartition and compaction produce the smaller ones)."""
+        from ..tpu.batch import bucket_capacity
+        max_obs = max((op.output_batch_size for op in self._ops),
+                      default=0)
+        top = bucket_capacity(max(1, max_obs))
+        caps, c = [], bucket_capacity(1)
+        while c <= top:
+            caps.append(c)
+            c <<= 1
+        return caps
+
+    def _prewarm_device_programs(self) -> None:
+        if not any(getattr(op, "is_tpu", False) for op in self._ops):
+            # CPU-plane graph: nothing compiles, and we must not drag
+            # the device plane (jax) in just to find that out
+            self._prewarm_report = {"bucket_caps": [],
+                                    "signatures_compiled": 0,
+                                    "skipped": ["no device stages"],
+                                    "elapsed_s": 0.0}
+            return
+        t0 = time.monotonic()
+        caps = self._bucket_caps()
+        warmed = 0
+        skipped: List[str] = []
+        for s in self._stages:
+            first = s.first_op
+            if not getattr(first, "is_tpu", False):
+                continue
+            label = s.describe()
+            for r in {id(r): r for r in first.replicas}.values():
+                pw = getattr(r, "prewarm", None)
+                if pw is None:
+                    skipped.append(f"{label}: no prewarm hook "
+                                   f"({type(r).__name__})")
+                    continue
+                n = pw(caps)
+                if n is None:
+                    skipped.append(f"{label}: runtime-dependent "
+                                   "signature (stateful/inferred schema)")
+                else:
+                    warmed += n
+        self._prewarm_report = {
+            "bucket_caps": caps,
+            "signatures_compiled": warmed,
+            "skipped": skipped,
+            "elapsed_s": round(time.monotonic() - t0, 4),
+        }
+
+    @property
+    def prewarm_report(self) -> Optional[Dict[str, Any]]:
+        return self._prewarm_report
 
     # ------------------------------------------------------------------
     # self-healing supervision (windflow_tpu.supervision)
@@ -942,11 +1110,17 @@ class PipeGraph:
             jax.devices()
         # checkpoint store/coordinator BEFORE _build: workers bind to the
         # coordinator at construction, and sources anchor their barrier
-        # cursor to the restored epoch
+        # cursor to the restored epoch. SLO sampling too: replica
+        # histograms allocate at replica construction
+        self._ensure_slo_sampling()
         ckpt_dir, manifest = self._setup_checkpointing(restore_from)
         self._build()
         if ckpt_dir is not None:
             self._restore_replicas(ckpt_dir, manifest)
+        if self._prewarm_enabled:
+            # compile every bucketed chain signature BEFORE any source
+            # opens: cold-start pays here, the stream never retraces
+            self._prewarm_device_programs()
         if self._coordinator is not None:
             self._coordinator.expected_acks = len(self._workers)
             self._coordinator.worker_names = [w.name for w in self._workers]
@@ -984,6 +1158,12 @@ class PipeGraph:
             from ..scaling.autoscaler import Autoscaler
             self._autoscaler = Autoscaler(self, self._autoscale_policy)
             self._autoscaler.start()
+        # overload governor (with_slo / WF_SLO_P99_MS): created after the
+        # autoscaler so the SCALE rung can read its MAX_PAR and
+        # synchronize cooldowns
+        self._setup_overload_governor()
+        if self._overload_governor is not None:
+            self._overload_governor.start()
 
     def wait_end(self) -> None:
         if not self._started:
@@ -1021,6 +1201,8 @@ class PipeGraph:
             self._supervisor.stop()
         if self._autoscaler is not None:
             self._autoscaler.stop()
+        if self._overload_governor is not None:
+            self._overload_governor.stop()
         self.elapsed_sec = time.monotonic() - self._t0
         if self._watchdog is not None:
             self._watchdog.stop()
@@ -1124,6 +1306,10 @@ class PipeGraph:
             st["Autoscaler"] = self._autoscaler.stats()
         if self._supervisor is not None:
             st["Supervision"] = self._supervisor.stats()
+        if self._overload_governor is not None:
+            st["Overload"] = self._overload_governor.stats()
+        if self._prewarm_report is not None:
+            st["Prewarm"] = self._prewarm_report
         if self._dlq is not None:
             st["Dead_letters"] = self._dlq.total
         # crash visibility: a worker that died no longer disappears

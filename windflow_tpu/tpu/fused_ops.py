@@ -52,7 +52,8 @@ from ..runtime.dispatch import DeviceDispatchQueue
 from .batch import BatchTPU
 from .ops_tpu import (Filter_TPU, Map_TPU, Reduce_TPU, TPUReplicaBase,
                       _compact_order, _grid_scan_core, _KeyedStateScan,
-                      cached_compile, masked_tree_reduce)
+                      cached_compile, masked_tree_reduce,
+                      prewarm_zero_fields)
 
 
 class _SubSpec:
@@ -209,6 +210,28 @@ class FusedTPUReplica(TPUReplicaBase):
         # batch shapes churn shows up as a retrace storm in the trace
         return instrumented_jit(run, self.stats, label=self.fused_name,
                                 donate_argnums=(3,))
+
+    # -- compile-stability pre-warm ----------------------------------------
+    def prewarm(self, caps) -> Optional[int]:
+        """Compile the whole-chain program once per bucket capacity
+        (``PipeGraph.with_prewarm``). Stateless chains only: a stateful
+        sub-op's grid shape ``(M, KB)`` and table capacity are runtime
+        cardinality — their signatures cannot be enumerated at start."""
+        import jax
+
+        if self._engines:
+            return None
+        sch = self.op.schema
+        if sch is None:
+            return None
+        key = tuple(None for _ in self.specs)
+        prog = cached_compile(self._prog_cache, self._prog_lock, key,
+                              lambda: self._make(key))
+        hargs = tuple(None for _ in self.specs)
+        for cap in caps:
+            jax.block_until_ready(
+                prog(prewarm_zero_fields(sch, cap), 0, hargs, ()))
+        return len(caps)
 
     # -- batch path --------------------------------------------------------
     def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:

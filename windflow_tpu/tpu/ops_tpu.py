@@ -42,6 +42,19 @@ from .batch import BatchTPU, key_column_np, key_column_to_list
 from .schema import TupleSchema
 
 
+def prewarm_zero_fields(schema: "TupleSchema", cap: int):
+    """Zero-valued device columns at one bucket capacity — the dummy
+    input the compile-stability pre-warm feeds a program so its
+    (shape, dtype) signature traces before any real batch arrives.
+    ``device_put`` of schema-dtyped numpy matches the staging emitters'
+    transfer path, so the traced signature is byte-for-byte the one the
+    stream will present."""
+    import jax
+
+    return {name: jax.device_put(np.zeros(cap, dtype=dt))
+            for name, dt in schema.fields.items()}
+
+
 def _compact_order(keep):
     """Stable keepers-first permutation as GATHER indices, via cumsum +
     one scatter — equivalent to ``argsort(~keep, stable)`` but O(n)
@@ -541,6 +554,20 @@ class MapTPUReplica(TPUReplicaBase):
                                 "return a dict of columns")
         self._emit_batch(batch.with_fields(out))
 
+    def prewarm(self, caps) -> Optional[int]:
+        """Compile-stability pre-warm (``PipeGraph.with_prewarm``): trace
+        the program once per bucket capacity on zero dummies — pure
+        function, no state, no emit. None when the schema is inferred at
+        the staging boundary (nothing to synthesize from yet)."""
+        import jax
+        sch = self.op.schema
+        if sch is None:
+            return None
+        for cap in caps:
+            jax.block_until_ready(
+                self._jitted(prewarm_zero_fields(sch, cap)))
+        return len(caps)
+
 
 class _KeyedStateScan:
     """Shared keyed device-state machinery for stateful Map/Filter.
@@ -843,6 +870,18 @@ class FilterTPUReplica(TPUReplicaBase):
         self.stats.device_programs_run += 1
         self.emit_compacted(batch, out, order, count)
 
+    def prewarm(self, caps) -> Optional[int]:
+        """See ``MapTPUReplica.prewarm`` (``size`` traces as a weak
+        scalar, so one warm call per capacity covers every real size)."""
+        import jax
+        sch = self.op.schema
+        if sch is None:
+            return None
+        for cap in caps:
+            jax.block_until_ready(
+                self._jitted(prewarm_zero_fields(sch, cap), 0))
+        return len(caps)
+
     # empty batches are dropped entirely (the reference shrinks to zero and
     # forwards; dropping is equivalent because watermarks flow via puncts)
 
@@ -897,6 +936,17 @@ class GlobalReduceTPUReplica(TPUReplicaBase):
 
         self._jitted = instrumented_jit(run, self.stats, label=op.name)
 
+    def prewarm(self, caps) -> Optional[int]:
+        """See ``MapTPUReplica.prewarm``."""
+        import jax
+        sch = self.op.schema
+        if sch is None:
+            return None
+        for cap in caps:
+            jax.block_until_ready(
+                self._jitted(prewarm_zero_fields(sch, cap), 0))
+        return len(caps)
+
     def process_device_batch(self, batch: BatchTPU) -> None:
         if batch.size == 0:
             return
@@ -942,6 +992,21 @@ class ReduceTPUReplica(TPUReplicaBase):
             return {k: v[idx] for k, v in scanned.items()}
 
         self._jitted = instrumented_jit(run, self.stats, label=op.name)
+
+    def prewarm(self, caps) -> Optional[int]:
+        """See ``MapTPUReplica.prewarm`` — the keyed reduce's program
+        signature is (fields, order, slots) at one capacity; the
+        order/slot VALUES are runtime data, not signature."""
+        import jax
+        sch = self.op.schema
+        if sch is None:
+            return None
+        for cap in caps:
+            order = jax.device_put(np.arange(cap, dtype=np.int32))
+            slots = jax.device_put(np.zeros(cap, dtype=np.int32))
+            jax.block_until_ready(
+                self._jitted(prewarm_zero_fields(sch, cap), order, slots))
+        return len(caps)
 
     def _order_and_slots(self, batch: BatchTPU):
         """(order, sorted slot ids, slot->key map) with ONE sort: int
