@@ -689,6 +689,9 @@ class KafkaSourceReplica(BasicReplica):
         # SourceReplica._gate (shed before emit; a shed Kafka record's
         # offset is already consumed, so it never replays)
         self._gate = None
+        # gate-buffered records caught by a snapshot (their offsets are
+        # already consumed — run_source re-emits them after restore)
+        self._restore_gate_pending = None
 
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Kafka_Source has no input")
@@ -754,6 +757,14 @@ class KafkaSourceReplica(BasicReplica):
         # SourceReplica): restore must not zero permanent drops
         st["shed_records"] = self.stats.shed_records
         st["shed_bytes"] = self.stats.shed_bytes
+        gate = self._gate
+        if gate is not None and gate.pending:
+            # records accepted into the gate but still awaiting tokens:
+            # their offsets are covered by the snapshot positions above,
+            # so they never replay from the broker — they must ride the
+            # snapshot or a restore loses them (neither admitted nor
+            # shed)
+            st["gate_pending"] = gate.snapshot_pending()
         return st
 
     def restore_state(self, state: dict) -> None:
@@ -761,11 +772,22 @@ class KafkaSourceReplica(BasicReplica):
         offs = state.get("offsets")
         if offs is not None:
             self._restore_offsets = dict(offs)
+        self._restore_gate_pending = state.get("gate_pending")
         self.stats.shed_records = state.get("shed_records", 0)
         self.stats.shed_bytes = state.get("shed_bytes", 0)
 
     def run_source(self) -> None:
         op = self.op
+        pend = self._restore_gate_pending
+        if pend:
+            # re-emit the snapshot's gate-buffered records before the
+            # consume loop resumes (their offsets never replay); ahead
+            # of the subscribe so a no-partition early return cannot
+            # drop them
+            self._restore_gate_pending = None
+            for p, t, w in pend:
+                self._advance_wm(w)
+                self._emit_admitted(p, t)
         transport = make_transport(op.brokers)
         if self._coord is not None and hasattr(transport, "auto_commit"):
             transport.auto_commit = False  # commits ride checkpoints only
@@ -787,6 +809,15 @@ class KafkaSourceReplica(BasicReplica):
                                        n_members, offsets):
                 return
             self._consume_loop(transport)
+            gate = self._gate
+            if gate is not None and gate.pending:
+                # end-of-stream with records still buffered in the
+                # gate: they were ACCEPTED (only awaiting tokens) —
+                # emit before the final barrier injects, mirroring
+                # SourceReplica.run_source
+                for p, t, w in gate.drain_pending():
+                    self._advance_wm(w)
+                    self._emit_admitted(p, t)
         finally:
             # the worker's final_checkpoint hook runs after run_source —
             # too late for the transport; inject any pending epoch with
@@ -823,15 +854,20 @@ class KafkaSourceReplica(BasicReplica):
             time.sleep(0.001)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
-        if wm > self.cur_wm:
-            self.cur_wm = wm
         gate = self._gate
         if gate is not None:
-            for p, t in gate.offer(payload, ts):
+            # watermark rides each record through the gate (see
+            # SourceReplica.ship): a buffered record emits under its
+            # accept-time watermark, never one the stream advanced to
+            # while it waited
+            for p, t, w in gate.offer(payload, ts, wm):
+                self._advance_wm(w)
                 self._emit_admitted(p, t)
             if gate.released and not gate.pending:
                 self._gate = None
             return
+        if wm > self.cur_wm:
+            self.cur_wm = wm
         self._emit_admitted(payload, ts)
 
     def _emit_admitted(self, payload: Any, ts: int) -> None:

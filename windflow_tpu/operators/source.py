@@ -136,6 +136,10 @@ class SourceReplica(BasicReplica):
         # exactly-once plane — so shed records never enter a channel,
         # a snapshot or a sink transaction.
         self._gate = None
+        # records that were buffered in an admission gate at snapshot
+        # time (restore_state stashes them; run_source re-emits before
+        # the functor resumes — the cursor is already past them)
+        self._restore_gate_pending = None
 
     def process(self, payload, ts, wm, tag):  # pragma: no cover
         raise WindFlowError("Source has no input")
@@ -185,11 +189,20 @@ class SourceReplica(BasicReplica):
         if snap is not None:
             st["position"] = (snap(self.context) if arity(snap) >= 1
                               else snap())
+        gate = self._gate
+        if gate is not None and gate.pending:
+            # records accepted into the gate but still awaiting tokens:
+            # the position above already covers them (the cursor
+            # advanced when they were pushed), so they must ride the
+            # snapshot — a restore that dropped them would lose records
+            # that are neither admitted nor shed
+            st["gate_pending"] = gate.snapshot_pending()
         return st
 
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
         self._restore_position = state.get("position")
+        self._restore_gate_pending = state.get("gate_pending")
         self.stats.inputs_received = state.get("shipped", 0)
         self.stats.shed_records = state.get("shed_records", 0)
         self.stats.shed_bytes = state.get("shed_bytes", 0)
@@ -209,6 +222,17 @@ class SourceReplica(BasicReplica):
                 restore(self._restore_position, self.context)
             else:
                 restore(self._restore_position)
+        pend = self._restore_gate_pending
+        if pend:
+            # records the snapshot caught inside an admission gate's
+            # buffer: the restored cursor is already past them, so the
+            # functor will never regenerate them — re-emit (with their
+            # accept-time watermarks) before the loop resumes, ahead of
+            # everything the replay produces
+            self._restore_gate_pending = None
+            for p, t, w in pend:
+                self._advance_wm(w)
+                self._emit_admitted(p, t)
         if self.op._riched:
             self.op.func(shipper, self.context)
         else:
@@ -218,7 +242,8 @@ class SourceReplica(BasicReplica):
             # end-of-stream with records still buffered in the admission
             # gate: they were ACCEPTED (only awaiting tokens) — emit them
             # rather than silently dropping accepted data at EOS
-            for p, t in gate.drain_pending():
+            for p, t, w in gate.drain_pending():
+                self._advance_wm(w)
                 self._emit_admitted(p, t)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
@@ -229,15 +254,21 @@ class SourceReplica(BasicReplica):
         if self._coord is not None \
                 and self._coord.requested_id != self._last_ckpt:
             self._maybe_inject()
-        if wm > self.cur_wm:
-            self.cur_wm = wm
         gate = self._gate
         if gate is not None:
-            for p, t in gate.offer(payload, ts):
+            # the watermark rides each record through the gate: while
+            # records wait in its buffer ``cur_wm`` must NOT advance
+            # past them, or they would emit under a watermark newer
+            # than their ts and downstream windows the gate chose to
+            # ADMIT them into would already be closed
+            for p, t, w in gate.offer(payload, ts, wm):
+                self._advance_wm(w)
                 self._emit_admitted(p, t)
             if gate.released and not gate.pending:
                 self._gate = None  # recovery: back to the ungated path
             return
+        if wm > self.cur_wm:
+            self.cur_wm = wm
         self._emit_admitted(payload, ts)
 
     def _emit_admitted(self, payload: Any, ts: int) -> None:
@@ -251,16 +282,24 @@ class SourceReplica(BasicReplica):
         if self._coord is not None \
                 and self._coord.requested_id != self._last_ckpt:
             self._maybe_inject()  # before the push, like ship()
-        if wm > self.cur_wm:
-            self.cur_wm = wm
         gate = self._gate
         if gate is not None:
+            if gate.pending:
+                # row-path records accepted into the buffer precede
+                # this batch: emit them (with their accept-time
+                # watermarks) first — discarding them here would lose
+                # accepted records, emitting them later would reorder
+                for p, t, w in gate.drain_pending():
+                    self._advance_wm(w)
+                    self._emit_admitted(p, t)
             if gate.released:
-                self._gate = None  # columnar gates buffer nothing
+                self._gate = None  # recovery: back to the ungated path
             else:
                 cols, ts_arr, n = gate.offer_columns(cols, ts_arr)
                 if n == 0:
                     return
+        if wm > self.cur_wm:
+            self.cur_wm = wm
         self.stats.inputs_received += len(ts_arr)
         if self.stats.sample_every:
             # columnar pushes sample at push granularity (one stamp per

@@ -143,11 +143,16 @@ def _approx_bytes(payload: Any) -> int:
 class AdmissionGate:
     """Per-source-replica admission controller (see module doc).
 
-    ``offer(payload, ts)`` returns the records to emit NOW (possibly
-    buffered predecessors, possibly empty); shed records are accounted
-    on the replica's stats and streamed to the shed log before the call
-    returns. The gate never blocks and never reorders admitted records
-    (priority only decides what gets EVICTED)."""
+    ``offer(payload, ts, wm)`` returns ``(payload, ts, wm)`` triples to
+    emit NOW (possibly buffered predecessors, possibly empty); shed
+    records are accounted on the replica's stats and streamed to the
+    shed log before the call returns. The gate never blocks and never
+    reorders admitted records (priority only decides what gets
+    EVICTED). The watermark rides each record: a buffered record must
+    emit with the watermark current when it was ACCEPTED — emitting it
+    under whatever the stream advanced to while it waited would land it
+    past downstream window closures the gate never chose to shed it
+    into."""
 
     def __init__(self, replica, policy: str, rate_tps: float,
                  priority_fn: Optional[Callable[[Any], Any]] = None,
@@ -163,7 +168,7 @@ class AdmissionGate:
         self.priority_fn = priority_fn
         self.shed_log = shed_log
         self.buffer_cap = max(1, int(buffer_cap))
-        self._pending: deque = deque()  # (payload, ts) awaiting tokens
+        self._pending: deque = deque()  # (payload, ts, wm) awaiting tokens
         # recovery: the governor flips ``released`` (pass-through mode —
         # everything admits, buffered records first) and the SOURCE
         # thread clears its own ``_gate`` reference on the next push;
@@ -184,10 +189,11 @@ class AdmissionGate:
                                payload, ts, reason)
 
     # -- row path ----------------------------------------------------------
-    def offer(self, payload: Any, ts: int) -> List[Tuple[Any, int]]:
+    def offer(self, payload: Any, ts: int, wm: int = 0
+              ) -> List[Tuple[Any, int, int]]:
         if self.released:  # pass-through: buffered first, then incoming
             out = self.drain_pending()
-            out.append((payload, ts))
+            out.append((payload, ts, wm))
             return out
         pol = self.policy
         if pol == "probabilistic":
@@ -199,17 +205,17 @@ class AdmissionGate:
             p_admit = 1.0 if self._offered_ewma <= 0 else min(
                 1.0, self.bucket.rate / self._offered_ewma)
             if self._rng.random() < p_admit:
-                return [(payload, ts)]
+                return [(payload, ts, wm)]
             self._account(payload, ts, "probabilistic")
             return []
         if pol == "drop_newest":
             if self.bucket.try_take():
-                return [(payload, ts)]
+                return [(payload, ts, wm)]
             self._account(payload, ts, "drop_newest")
             return []
         # buffered policies: drop_oldest / key_priority
-        self._pending.append((payload, ts))
-        out: List[Tuple[Any, int]] = []
+        self._pending.append((payload, ts, wm))
+        out: List[Tuple[Any, int, int]] = []
         while self._pending and self.bucket.try_take():
             out.append(self._pending.popleft())
         while len(self._pending) > self.buffer_cap:
@@ -249,13 +255,21 @@ class AdmissionGate:
                 ts_arr[:grant], grant)
 
     # -- lifecycle ---------------------------------------------------------
-    def drain_pending(self) -> List[Tuple[Any, int]]:
+    def drain_pending(self) -> List[Tuple[Any, int, int]]:
         """Disengage: everything still buffered is ADMITTED (it was
         accepted into the gate, only awaiting tokens — shedding it on
         recovery would drop records the overload no longer forces)."""
         out = list(self._pending)
         self._pending.clear()
         return out
+
+    def snapshot_pending(self) -> List[Tuple[Any, int, int]]:
+        """The buffered records, for the source replica's checkpoint
+        snapshot. They were pushed (the source cursor is past them) but
+        not emitted and not shed — a restore that dropped them would
+        break offered == admitted + shed. The source re-emits the
+        snapshot's copy after restore; the live gate keeps its buffer."""
+        return list(self._pending)
 
     @property
     def pending(self) -> int:

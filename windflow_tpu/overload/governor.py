@@ -213,6 +213,12 @@ class OverloadGovernor(threading.Thread):
         self._prev_e2e: Optional[List[int]] = None
         self._prev_counts: Optional[Dict[str, float]] = None
         self._prev_t = 0.0
+        # windowed blocked-put plane for the SCALE rung (sampled every
+        # tick; _try_scale must rank the LIVE bottleneck, not whoever
+        # accumulated the most backpressure since process start)
+        self._prev_blocked: Optional[Dict[str, Dict[str, float]]] = None
+        self._prev_blocked_t = 0.0
+        self._blocked_rates: Dict[str, float] = {}
         self._last_shed_active_t = float("-inf")
         self._rec = None  # lazy flight ring ("overload" track)
 
@@ -380,6 +386,7 @@ class OverloadGovernor(threading.Thread):
         if p99 is None and q_est <= 0.0:
             p99_eff = None
         self._window_rates(now)
+        self._window_blocked(now)
         if self.shedding or self.shed_tps > 0:
             self._last_shed_active_t = now
         directive = self.policy.observe(p99_eff, self.shed_tps, now)
@@ -482,10 +489,10 @@ class OverloadGovernor(threading.Thread):
         self._tuned = []
 
     # -- rung 2: scale -----------------------------------------------------
-    def _eligible_rates(self) -> Dict[str, Dict[str, float]]:
-        """Blocked-put totals for rescalable stages (same signal the
-        autoscaler rates; the governor acts on the instantaneous worst —
-        its own hysteresis already debounced the breach)."""
+    def _eligible_totals(self) -> Dict[str, Dict[str, float]]:
+        """Cumulative blocked-put totals for rescalable stages (the raw
+        counters; ``_window_blocked`` diffs them tick-over-tick into the
+        rates the SCALE rung actually ranks by)."""
         from ..scaling.repartition import repartition_refusal
         out: Dict[str, Dict[str, float]] = {}
         for s in self.graph._stages:
@@ -502,6 +509,27 @@ class OverloadGovernor(threading.Thread):
                             "blocked_put_usec": blocked}
         return out
 
+    def _window_blocked(self, now: float) -> None:
+        """Blocked-put usec/s per eligible stage over THIS window
+        (tick-over-tick diff, the autoscaler's idiom): an operator with
+        large historical backpressure but no current congestion must
+        not outrank the live bottleneck."""
+        cur = self._eligible_totals()
+        prev, self._prev_blocked = self._prev_blocked, cur
+        prev_t, self._prev_blocked_t = self._prev_blocked_t, now
+        if prev is None or now <= prev_t:
+            self._blocked_rates = {}
+            return
+        dt = now - prev_t
+        rates: Dict[str, float] = {}
+        for name, m in cur.items():
+            p = prev.get(name)
+            if p is None or p["parallelism"] != m["parallelism"]:
+                continue  # fresh op or mid-rescale counter reset: skip
+            rates[name] = max(
+                0.0, m["blocked_put_usec"] - p["blocked_put_usec"]) / dt
+        self._blocked_rates = rates
+
     def _try_scale(self) -> bool:
         g = self.graph
         if g._coordinator is None:
@@ -509,10 +537,18 @@ class OverloadGovernor(threading.Thread):
         auto = getattr(g, "_autoscaler", None)
         max_par = auto.policy.max_parallelism if auto is not None \
             else self.policy.max_parallelism
-        rates = self._eligible_rates()
-        cand = [(m["blocked_put_usec"], name, int(m["parallelism"]))
-                for name, m in rates.items()
-                if int(m["parallelism"]) < max_par]
+        totals = self._eligible_totals()
+        win = self._blocked_rates
+        cand = []
+        for name, m in totals.items():
+            par = int(m["parallelism"])
+            if par >= max_par:
+                continue
+            # windowed rate once a full tick exists; before the first
+            # window the cumulative total is the only signal there is
+            blocked = win[name] if name in win \
+                else (0.0 if win else m["blocked_put_usec"])
+            cand.append((blocked, name, par))
         if not cand:
             return False  # scale-out exhausted: the shed rung is next
         cand.sort(reverse=True)
@@ -539,10 +575,20 @@ class OverloadGovernor(threading.Thread):
         replicas = list(self._source_replicas())
         if not replicas:
             raise WindFlowError("overload governor: no gateable sources")
-        # initial admit rate = measured downstream capacity (the admitted
-        # throughput while breached IS what the graph absorbs), derated
-        rate = max(self.policy.min_rate_tps,
-                   self.admitted_tps * self.policy.shed_start_factor)
+        if self.admit_rate_tps > 0:
+            # re-engage after a supervised restart/rescale (gates
+            # pruned, rung still SHED): reuse the rate the AIMD loop
+            # had converged to — the windowed counters rewound with the
+            # replicas, so admitted_tps is zero/stale this tick and
+            # deriving from it would collapse the admit rate to the
+            # floor and over-shed until the slow probe recovers
+            rate = max(self.policy.min_rate_tps, self.admit_rate_tps)
+        else:
+            # first engagement: admit rate = measured downstream
+            # capacity (the admitted throughput while breached IS what
+            # the graph absorbs), derated
+            rate = max(self.policy.min_rate_tps,
+                       self.admitted_tps * self.policy.shed_start_factor)
         self.admit_rate_tps = rate
         per = rate / len(replicas)
         for r in replicas:
