@@ -46,7 +46,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
-             "supervised_kill", "overload_kill")
+             "supervised_kill", "overload_kill", "mesh_kill")
 
 
 class InjectedCrash(Exception):
@@ -261,6 +261,113 @@ def _overload_kill_round(rng, report, workdir) -> dict:
     return report
 
 
+def _mesh_kill_round(rng, report, workdir) -> dict:
+    """``--mesh``: kill a mesh pipeline MID-STREAM under supervision.
+    A replayable source feeds a mesh-sharded stateful Map (grid-scan
+    key table block-sharded over the virtual 8-device mesh) into an
+    exactly-once sink; the source crashes once after a checkpoint
+    committed. Checks:
+
+    - the supervisor recovers the graph in-process (one restart), the
+      sharded state restoring from its per-shard checkpoint blocks;
+    - the committed exactly-once records are byte-identical to an
+      uninterrupted golden run — the running per-key state picks up
+      exactly where the checkpoint cut it.
+    """
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, RestartPolicy,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.sinks.transactional import read_committed_records
+
+    import jax
+    if len(jax.devices()) < 8:
+        report.update(ok=True, skipped="needs 8 virtual devices "
+                      "(run via ensure_virtual_devices)")
+        return report
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n, nk = 1600, 7
+    crash_at = rng.randrange(int(n * 0.5), int(n * 0.85))
+    ckpt_at = sorted(rng.sample(range(int(n * 0.1), int(n * 0.45)), 2))
+    report.update(n=n, nk=nk, crash_at=crash_at, ckpt_at=ckpt_at)
+
+    def build(store, txn, src, rows, supervised):
+        g = PipeGraph("chaos_mesh", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        if supervised:
+            g.with_supervision(RestartPolicy(max_restarts=4,
+                                             backoff_s=0.02,
+                                             backoff_max_s=0.2))
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": row["v"],
+                                  "run": st + row["v"]}, st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_mesh(key_capacity=nk).with_name("mscan").build())
+
+        def sink(t):
+            if t is not None:
+                rows.append((int(t["k"]), float(t["v"]), float(t["run"])))
+
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(64).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk")
+                      .with_exactly_once(staging_dir=txn).build())
+        return g
+
+    def committed(txn):
+        return sorted((int(r["k"]), float(r["v"]), float(r["run"]))
+                      for r, _ in read_committed_records(
+                          os.path.join(txn, "snk_r0")))
+
+    class MeshSource(ChaosSource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.crash_at is not None and self.pos == self.crash_at \
+                        and (self.crash_times is None
+                             or self.crashes < self.crash_times):
+                    self.crashes += 1
+                    raise InjectedCrash(f"killed at {self.pos}")
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": float(v + 1)})
+                self.pos += 1
+                if self.pos in self.ckpt_at:
+                    shipper.request_checkpoint()
+
+    gold_rows = []
+    build(os.path.join(workdir, "gold_store"), os.path.join(workdir,
+                                                            "gold_txn"),
+          MeshSource(n, nk), gold_rows, supervised=False).run()
+    golden = committed(os.path.join(workdir, "gold_txn"))
+
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    rows = []
+    g = build(store, txn, MeshSource(n, nk, ckpt_at, crash_at,
+                                     crash_times=1), rows,
+              supervised=True)
+    g.run()  # recovers in-process; raising here fails the round
+    sup = g.get_stats().get("Supervision", {})
+    segs = committed(txn)
+    problems = []
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if segs != golden:
+        dup = len(segs) - len(set(segs))
+        lost = len([x for x in golden if x not in set(segs)])
+        problems.append(f"committed records diverge from golden: "
+                        f"{dup} duplicate(s), {lost} lost "
+                        f"(got {len(segs)}, want {len(golden)})")
+    report.update(ok=not problems, problems=problems,
+                  results=len(golden),
+                  restarts=sup.get("Supervision_restarts", 0),
+                  mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
+
+
 def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
               nk: int = 7) -> dict:
     """One seeded chaos round; returns a report dict with ``ok``."""
@@ -269,10 +376,14 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
     import zlib
     rng = random.Random((seed << 8) ^ zlib.crc32(scenario.encode()) & 0xFFFF)
     os.makedirs(workdir, exist_ok=True)
+    report = {"scenario": scenario, "seed": seed, "n": n, "nk": nk}
+    if scenario == "mesh_kill":
+        # runs its own (mesh) golden pipeline — the CPU-windows golden
+        # below would be wasted work
+        return _mesh_kill_round(rng, report, workdir)
     golden = _golden(workdir, n, nk)
     store = os.path.join(workdir, "store")
     txn = os.path.join(workdir, "txn")
-    report = {"scenario": scenario, "seed": seed, "n": n, "nk": nk}
 
     if scenario == "kill_point":
         n_ckpts = rng.randint(1, 3)
@@ -434,14 +545,26 @@ def main() -> int:
                          "shed counters over, keep offered == admitted + "
                          "shed, and keep the exactly-once output "
                          "duplicate-free over the admitted set")
+    ap.add_argument("--mesh", action="store_true",
+                    help="kill a mesh pipeline mid-stream (sharded "
+                         "stateful map over the virtual 8-device mesh, "
+                         "supervision ON): the sharded state must restore "
+                         "from its per-shard checkpoint blocks with "
+                         "byte-identical exactly-once output")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (e.g. "
                          "results/chaos.json)")
     args = ap.parse_args()
+    # the mesh round needs the virtual multi-device platform; must land
+    # before anything initializes jax (harmless for the CPU-only rounds)
+    from windflow_tpu.mesh import ensure_virtual_devices
+    ensure_virtual_devices()
     if args.supervised:
         scenarios = ("supervised_kill",)
     elif args.overload:
         scenarios = ("overload_kill",)
+    elif args.mesh:
+        scenarios = ("mesh_kill",)
     else:
         scenarios = (args.scenario,) if args.scenario else SCENARIOS
     report = run_sweep(args.seed, args.rounds, scenarios, n=args.n)

@@ -34,6 +34,12 @@ import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# the mesh leg needs the virtual multi-device platform; must land before
+# anything initializes jax (no-op under pytest — conftest already did it)
+from windflow_tpu.mesh import ensure_virtual_devices  # noqa: E402
+
+ensure_virtual_devices()
+
 REQUIRED_FAMILIES = (
     "windflow_inputs_received_total",
     "windflow_outputs_sent_total",
@@ -74,6 +80,15 @@ REQUIRED_FAMILIES = (
     "windflow_overload_state",
     "windflow_overload_escalations_total",
     "windflow_overload_slo_p99_seconds",
+    # mesh execution plane (a second graph runs a mesh-sharded stateful
+    # map over the virtual 8-device mesh; Mesh_* stats exist only on
+    # mesh replicas, so these families prove the mesh plane exports)
+    "windflow_mesh_devices",
+    "windflow_mesh_steps_total",
+    "windflow_mesh_shuffle_bytes_total",
+    "windflow_mesh_step_seconds_total",
+    "windflow_mesh_shard_occupancy",
+    "windflow_mesh_shard_skew",
 )
 
 _SAMPLE_RE = re.compile(
@@ -158,6 +173,39 @@ def validate_chrome_trace(doc) -> list:
                 if not isinstance(v, (int, float)) or v < 0:
                     errors.append(f"event {i}: {k}={v!r} (want >= 0)")
     return errors
+
+
+def run_mesh_graph():
+    """A second tiny graph exercising the mesh execution plane: source
+    -> mesh-sharded stateful Map (virtual 8-device mesh) -> sink, so
+    the ``windflow_mesh_*`` families have real samples. Reports to the
+    same monitoring server via the env already set by the caller."""
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    def src(shipper):
+        for i in range(2_000):
+            shipper.push({"k": i % 7, "v": float(i + 1)})
+
+    seen = [0]
+    g = PipeGraph("check_metrics_mesh", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    op = (Map_TPU_Builder(
+            lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                             st + row["v"]))
+          .with_state(np.float32(0)).with_key_by("k")
+          .with_mesh(key_capacity=7).with_name("mscan").build())
+    g.add_source(Source_Builder(src).with_name("msrc")
+                 .with_output_batch_size(64).build()) \
+        .add(op) \
+        .add_sink(Sink_Builder(
+            lambda t: seen.__setitem__(0, seen[0] + 1) if t else None)
+            .with_name("mout").build())
+    g.run()
+    assert seen[0] == 2_000, f"mesh sink saw {seen[0]} tuples"
 
 
 def run_graph_and_scrape():
@@ -246,12 +294,17 @@ def run_graph_and_scrape():
         sup = g.get_stats().get("Supervision", {})
         assert sup.get("Supervision_restarts") == 1, \
             f"expected 1 supervised restart, saw {sup}"
+        # the mesh-plane leg: a second graph over the virtual mesh so the
+        # windflow_mesh_* families carry real samples
+        run_mesh_graph()
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land
         import time
         deadline = time.monotonic() + 10.0
         while time.monotonic() < deadline:
-            if "check_metrics" in server.snapshot()["reports"]:
+            reports = server.snapshot()["reports"]
+            if "check_metrics" in reports \
+                    and "check_metrics_mesh" in reports:
                 break
             time.sleep(0.05)
         else:

@@ -1,7 +1,9 @@
-"""Randomized differential soak for Ffat_Windows_Mesh: random mesh
-shapes, sparse/negative keys, win/slide, watermark cadence, IDLE GAPS
-(the round-4 fast-forward surface), batch sizes — vs an origin-anchored
-oracle. Prints mismatching configs; exits nonzero iff any run failed."""
+"""Randomized differential soak for the mesh execution plane: FFAT mesh
+windows (random mesh shapes, sparse/negative keys, win/slide, watermark
+cadence, IDLE GAPS — the round-4 fast-forward surface, batch sizes — vs
+an origin-anchored oracle), PLUS the sharded ops (Map_Mesh running
+state, Reduce_Mesh per-batch keyed combine) vs exact python oracles.
+Prints mismatching configs; exits nonzero iff any run failed."""
 import os
 import random
 import sys
@@ -10,20 +12,26 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from windflow_tpu.mesh import ensure_virtual_devices  # noqa: E402
+
+ensure_virtual_devices()
+
 BUDGET_S = float(os.environ.get("SOAK_S", "1200"))
 
-import numpy as np
+import numpy as np  # noqa: E402
 
-from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,  # noqa: E402
                           Source_Builder, TimePolicy)
-from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+from windflow_tpu.tpu import (Ffat_Windows_TPU_Builder,  # noqa: E402
+                              Map_TPU_Builder, Reduce_TPU_Builder)
 
 t_end = time.monotonic() + BUDGET_S
 runs = fails = 0
 rng = random.Random(os.environ.get("SOAK_SEED", "1"))
 
-while time.monotonic() < t_end:
-    runs += 1
+
+def soak_ffat(runs):
+    """One randomized FFAT-mesh round; returns (ok, cfg_or_error)."""
     n_keys = rng.choice([1, 2, 3, 7, 11])
     sparse = rng.random() < 0.5
     keymap = ([k for k in range(n_keys)] if not sparse else
@@ -45,7 +53,6 @@ while time.monotonic() < t_end:
     gap = rng.choice([0, 0, 60, 200])  # in ts-steps
     p2 = rng.choice([0, 30, 60])
     ts_step = rng.choice([37, 97])
-    seed = rng.randrange(1 << 30)
 
     def src(shipper, ctx):
         i = 0
@@ -81,55 +88,136 @@ while time.monotonic() < t_end:
                 dups[0] += 1
             rows[kk] = r["value"]
 
-    cfg = dict(n_keys=n_keys, sparse=sparse, win=win_us, slide=slide_us,
-               obs=obs, wm_every=wm_every, shape=mesh_shape,
-               fr=fire_rounds, p1=p1, gap=gap, p2=p2, ts_step=ts_step,
-               lp=late_policy)
-    try:
-        g = PipeGraph(f"msoak{runs}", ExecutionMode.DEFAULT,
-                      TimePolicy.EVENT_TIME)
-        op = (Ffat_Windows_TPU_Builder(
-                lambda f: {"value": f["value"]},
-                lambda a, b: {"value": a["value"] + b["value"]})
-              .with_key_by("key").with_tb_windows(win_us, slide_us)
-              .with_key_capacity(n_keys)
-              .with_mesh(mesh_shape=mesh_shape, fire_rounds=fire_rounds,
-                         late_policy=late_policy)
+    cfg = dict(mode="ffat", n_keys=n_keys, sparse=sparse, win=win_us,
+               slide=slide_us, obs=obs, wm_every=wm_every,
+               shape=mesh_shape, fr=fire_rounds, p1=p1, gap=gap, p2=p2,
+               ts_step=ts_step, lp=late_policy)
+    g = PipeGraph(f"msoak{runs}", ExecutionMode.DEFAULT,
+                  TimePolicy.EVENT_TIME)
+    op = (Ffat_Windows_TPU_Builder(
+            lambda f: {"value": f["value"]},
+            lambda a, b: {"value": a["value"] + b["value"]})
+          .with_key_by("key").with_tb_windows(win_us, slide_us)
+          .with_key_capacity(n_keys)
+          .with_mesh(mesh_shape=mesh_shape, fire_rounds=fire_rounds,
+                     late_policy=late_policy)
+          .build())
+    g.add_source(Source_Builder(src).with_output_batch_size(obs)
+                 .build()).add(op).add_sink(Sink_Builder(sink).build())
+    g.run()
+    # oracle: origin-anchored TB; only VALID (non-empty) windows
+    idx = [i for i in range(p1)] + \
+          [p1 + gap + j for j in range(p2)]
+    pane = int(np.gcd(win_us, slide_us))
+    win_p, slide_p = win_us // pane, slide_us // pane
+    panes = {}
+    for i in idx:
+        p = (i * ts_step) // pane
+        panes.setdefault(p, 0.0)
+        panes[p] += i + 1
+    exp1 = {}
+    max_p = max(panes)
+    w = 0
+    while w * slide_p <= max_p:
+        s = sum(v for p, v in panes.items()
+                if w * slide_p <= p < w * slide_p + win_p)
+        if s:
+            exp1[w] = s
+        w += 1
+    exp = {(k, w): v for k in keymap for w, v in exp1.items()}
+    if rows != exp or dups[0]:
+        miss = {k: (exp.get(k), rows.get(k))
+                for k in set(exp) | set(rows)
+                if exp.get(k) != rows.get(k)}
+        return False, (cfg, dups[0], dict(list(miss.items())[:6]))
+    return True, cfg
+
+
+def soak_sharded(runs):
+    """One randomized sharded-op round (Map_Mesh running state or
+    Reduce_Mesh per-batch combine) vs an exact python oracle."""
+    mode = rng.choice(["scan", "reduce"])
+    n_keys = rng.choice([1, 3, 7, 13])
+    sparse = rng.random() < 0.5
+    keymap = ([k for k in range(n_keys)] if not sparse else
+              [(k * 2_654_435_761 - 3_000_000_000) * (3 + k)
+               for k in range(n_keys)])
+    n = rng.choice([150, 300, 600])
+    obs = rng.choice([16, 32, 64])
+    mesh_shape = rng.choice([None, (8, 1), (4, 2), (2, 4)])
+    cfg = dict(mode=mode, n_keys=n_keys, sparse=sparse, n=n, obs=obs,
+               shape=mesh_shape)
+
+    def src(shipper, ctx):
+        for i in range(n):
+            shipper.push({"key": keymap[i % n_keys],
+                          "v": float(i + 1)})
+
+    lock = threading.Lock()
+    rows = []
+    g = PipeGraph(f"ssoak{runs}", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    if mode == "scan":
+        def sink(t):
+            if t is not None:
+                with lock:
+                    rows.append((t["v"], t["run"]))
+
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"key": row["key"], "v": row["v"],
+                                  "run": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("key")
+              .with_mesh(mesh_shape=mesh_shape, key_capacity=n_keys)
               .build())
-        g.add_source(Source_Builder(src).with_output_batch_size(obs)
-                     .build()).add(op).add_sink(Sink_Builder(sink).build())
-        g.run()
-        # oracle: origin-anchored TB; only VALID (non-empty) windows
-        idx = [i for i in range(p1)] + \
-              [p1 + gap + j for j in range(p2)]
-        pane = int(np.gcd(win_us, slide_us))
-        win_p, slide_p = win_us // pane, slide_us // pane
-        panes = {}
-        for i in idx:
-            p = (i * ts_step) // pane
-            panes.setdefault(p, 0.0)
-            panes[p] += i + 1
-        exp1 = {}
-        max_p = max(panes)
-        w = 0
-        while w * slide_p <= max_p:
-            s = sum(v for p, v in panes.items()
-                    if w * slide_p <= p < w * slide_p + win_p)
-            if s:
-                exp1[w] = s
-            w += 1
-        exp = {(k, w): v for k in keymap for w, v in exp1.items()}
-        if rows != exp or dups[0]:
+    else:
+        def sink(t):
+            if t is not None:
+                with lock:
+                    rows.append(t["v"])
+
+        op = (Reduce_TPU_Builder(lambda a, b: {"v": a["v"] + b["v"]})
+              .with_key_by("key")
+              .with_mesh(mesh_shape=mesh_shape, key_capacity=n_keys)
+              .build())
+    g.add_source(Source_Builder(src).with_output_batch_size(obs)
+                 .build()).add(op).add_sink(Sink_Builder(sink).build())
+    g.run()
+    if mode == "scan":
+        st, exp = {}, []
+        for i in range(n):
+            k, v = keymap[i % n_keys], float(i + 1)
+            st[k] = st.get(k, 0.0) + v
+            exp.append((v, st[k]))
+        ok = sorted(rows) == sorted(exp)
+    else:
+        # per-batch keyed combine: the sink sees one value per distinct
+        # key per STAGED batch; the multiset of emitted sums is checked
+        # against the batch decomposition (obs-sized staging)
+        exp = []
+        for lo in range(0, n, obs):
+            sums = {}
+            for i in range(lo, min(lo + obs, n)):
+                k = keymap[i % n_keys]
+                sums[k] = sums.get(k, 0.0) + float(i + 1)
+            exp.extend(sums.values())
+        ok = sorted(rows) == sorted(exp)
+    return ok, cfg if ok else (cfg, sorted(rows)[:5], sorted(exp)[:5])
+
+
+while time.monotonic() < t_end:
+    runs += 1
+    try:
+        if rng.random() < 0.5:
+            ok, detail = soak_ffat(runs)
+        else:
+            ok, detail = soak_sharded(runs)
+        if not ok:
             fails += 1
-            miss = {k: (exp.get(k), rows.get(k))
-                    for k in set(exp) | set(rows)
-                    if exp.get(k) != rows.get(k)}
-            print(f"MISMATCH run={runs} cfg={cfg} dups={dups[0]} "
-                  f"diff[:6]={dict(list(miss.items())[:6])}", flush=True)
+            print(f"MISMATCH run={runs} detail={detail}", flush=True)
     except Exception as e:
         fails += 1
-        print(f"CRASH run={runs} cfg={cfg}: {type(e).__name__}: {e}",
-              flush=True)
+        print(f"CRASH run={runs}: {type(e).__name__}: {e}", flush=True)
 
 print(f"mesh soak done: {runs} runs, {fails} failures", flush=True)
 sys.exit(1 if fails else 0)

@@ -61,6 +61,18 @@ def test_chaos_overload_kill(tmp_path, seed):
     assert rep["governor_state"] is not None
 
 
+@pytest.mark.mesh
+def test_chaos_mesh_kill(tmp_path):
+    """Kill a mesh pipeline mid-stream under supervision: the sharded
+    grid-scan state restores from its per-shard checkpoint blocks and
+    the exactly-once output stays byte-identical to an uninterrupted
+    run."""
+    rep = chaos.run_round(9, "mesh_kill", str(tmp_path))
+    assert rep["ok"], rep["problems"]
+    assert rep.get("skipped") is None
+    assert rep["restarts"] == 1
+
+
 @pytest.mark.slow
 def test_chaos_sweep(tmp_path):
     rep = chaos.run_sweep(31, rounds=6, workdir=str(tmp_path))

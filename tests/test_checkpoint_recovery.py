@@ -684,3 +684,165 @@ def test_restore_into_differently_fused_topology_fails(tmp_path,
                             {}, str(tmp_path / "run4"))
     with pytest.raises(WindFlowError, match="fused"):
         g4.run(restore_from=store3)
+
+
+# ---------------------------------------------------------------------------
+# mesh execution plane: kill-and-restore onto a DIFFERENT mesh
+# factorization (windflow_tpu.mesh — sharded snapshot/restore)
+# ---------------------------------------------------------------------------
+@pytest.mark.mesh
+def test_mesh_scan_kill_and_restore_onto_different_mesh(tmp_path):
+    """A mesh-sharded stateful map (grid-scan key table block-sharded
+    over the 8-device mesh) killed mid-stream restores onto a DIFFERENT
+    mesh factorization — (8,1) checkpoint, (2,4) restore — with
+    byte-identical exactly-once output: the per-shard checkpoint blocks
+    relayout across the new shard count by slot-row gather."""
+    import threading
+
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.sinks.transactional import read_committed_records
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n, nk = 800, 7
+
+    def build(store, txn, src, shape):
+        g = PipeGraph("mesh_ck", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        lock = threading.Lock()
+        rows = []
+
+        def sink(t):
+            if t is not None:
+                with lock:
+                    rows.append((int(t["k"]), float(t["run"])))
+
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": row["v"],
+                                  "run": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_mesh(mesh_shape=shape, key_capacity=nk)
+              .with_name("mscan").build())
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(64).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk")
+                      .with_exactly_once(staging_dir=txn).build())
+        return g
+
+    def committed(txn):
+        return sorted(
+            (int(r["k"]), float(r["v"]), float(r["run"]))
+            for r, _ in read_committed_records(
+                os.path.join(txn, "snk_r0")))
+
+    class MeshSrc(ReplaySource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.crash_at is not None \
+                        and self.pos == self.crash_at:
+                    raise InjectedCrash(f"killed at {self.pos}")
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": float(v + 1)})
+                self.pos += 1
+                if self.ckpt_at is not None and self.pos == self.ckpt_at:
+                    assert shipper.request_checkpoint() is not None
+
+    gold_txn = str(tmp_path / "gold_txn")
+    build(str(tmp_path / "gold_store"), gold_txn,
+          MeshSrc(n, nk), (8, 1)).run()
+    golden = committed(gold_txn)
+    assert len(golden) == n
+
+    store, txn = str(tmp_path / "store"), str(tmp_path / "txn")
+    g = build(store, txn, MeshSrc(n, nk, ckpt_at=400, crash_at=650),
+              (8, 1))
+    with pytest.raises(InjectedCrash):
+        g.run()
+    # restore onto a different factorization: same flat owner space,
+    # different per-device row blocks
+    g2 = build(store, txn, MeshSrc(n, nk), (2, 4))
+    g2.run(restore_from=store)
+    segs = committed(txn)
+    assert segs == golden  # byte-identical, zero duplicates, zero loss
+
+
+@pytest.mark.mesh
+def test_mesh_ffat_kill_and_restore_onto_different_mesh(tmp_path):
+    """Ffat_Windows_Mesh killed mid-stream restores onto a different
+    mesh factorization: the per-shard forest blocks relayout (rows to
+    the new K_pad, leaves pane-remapped), and the merged window results
+    equal an uninterrupted run."""
+    import threading
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
+
+    nk, n_steps, ts_step = 5, 240, 37
+    win_us, slide_us = 800, 200
+
+    class WinSrc(ReplaySource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.crash_at is not None \
+                        and self.pos == self.crash_at:
+                    raise InjectedCrash(f"killed at {self.pos}")
+                i = self.pos
+                ts = i * ts_step
+                for k in range(nk):
+                    shipper.push_with_timestamp(
+                        {"key": k, "value": float(i + 1 + k)}, ts)
+                if i % 16 == 15:
+                    shipper.set_next_watermark(ts)
+                self.pos += 1
+                if self.ckpt_at is not None and self.pos == self.ckpt_at:
+                    assert shipper.request_checkpoint() is not None
+
+    def build(store, src, rows, shape):
+        g = PipeGraph("fm_ck", ExecutionMode.DEFAULT,
+                      TimePolicy.EVENT_TIME)
+        g.with_checkpointing(store_dir=store)
+        lock = threading.Lock()
+
+        def sink(r):
+            if r is None or not r["valid"]:
+                return
+            with lock:
+                rows[(r["key"], r["wid"])] = r["value"]
+
+        op = (Ffat_Windows_TPU_Builder(
+                lambda f: {"value": f["value"]},
+                lambda a, b: {"value": a["value"] + b["value"]})
+              .with_key_by("key").with_tb_windows(win_us, slide_us)
+              .with_key_capacity(nk).with_mesh(mesh_shape=shape)
+              .with_name("fwm").build())
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(64).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk").build())
+        return g
+
+    gold = {}
+    build(str(tmp_path / "gs"), WinSrc(n_steps, nk), gold, (8, 1)).run()
+    assert gold
+
+    store = str(tmp_path / "store")
+    crash_rows = {}
+    g = build(store, WinSrc(n_steps, nk, ckpt_at=120, crash_at=180),
+              crash_rows, (8, 1))
+    with pytest.raises(InjectedCrash):
+        g.run()
+    rest_rows = {}
+    g2 = build(store, WinSrc(n_steps, nk), rest_rows, (2, 4))
+    g2.run(restore_from=store)
+    # restored run wins ties: the crashed run's emergency EOS flushes
+    # PARTIAL windows (at-least-once sink; the EO differential is the
+    # scan test above)
+    merged = dict(crash_rows)
+    merged.update(rest_rows)
+    assert merged == gold

@@ -15,6 +15,8 @@ from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
                           Source_Builder, TimePolicy, WindFlowError)
 from windflow_tpu.tpu import Ffat_Windows_TPU_Builder
 
+pytestmark = pytest.mark.mesh  # shared conftest skip when devices short
+
 needs_multi = pytest.mark.skipif(len(jax.devices()) < 8,
                                  reason="needs 8 virtual devices")
 

@@ -7,6 +7,7 @@ import pytest
 
 import jax
 
+pytestmark = pytest.mark.mesh  # shared conftest skip when devices short
 
 needs_multi = pytest.mark.skipif(len(jax.devices()) < 8,
                                  reason="needs 8 virtual devices")

@@ -1,0 +1,404 @@
+"""Mesh-sharded keyed operators (windflow_tpu.mesh.ops_mesh) through the
+topology layer: mesh-reshape invariance differentials against the
+single-chip reference operators (8x1 / 4x2 / 2x4 over the same stream
+must equal the one-chip results — the FFAT-mesh property extended to the
+NEW sharded ops), plus the mesh-plane refusals (rescale, governor SCALE
+rung, non-snapshottable mesh ops under checkpointing) and the sharded
+snapshot -> relayout -> restore round-trip."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy, WindFlowError)
+from windflow_tpu.tpu import (Filter_TPU_Builder, Map_TPU_Builder,
+                              Reduce_TPU_Builder)
+
+pytestmark = pytest.mark.mesh  # shared conftest skip when devices short
+
+N, NK = 420, 7
+SHAPES = [(8, 1), (4, 2), (2, 4)]
+
+# sparse int64 ids, negative included — the KeySlotMap densifies them
+SPARSE_IDS = [(k * 2_654_435_761 - 5_000_000_000) * (11 + k)
+              for k in range(NK)]
+
+
+def _src(keymap=None):
+    keymap = keymap or list(range(NK))
+
+    def src(shipper, ctx):
+        for i in range(N):
+            shipper.push({"key": keymap[i % NK], "v": float(i + 1)})
+    return src
+
+
+class _Rows:
+    def __init__(self, fields):
+        self.fields = fields
+        self.rows = []
+        self._lock = threading.Lock()
+
+    def sink(self, t):
+        if t is not None:
+            with self._lock:
+                self.rows.append(tuple(t[f] for f in self.fields))
+
+    @property
+    def sorted(self):
+        with self._lock:
+            return sorted(self.rows)
+
+
+def _run(graph_name, op, coll, keymap=None, obs=64):
+    g = PipeGraph(graph_name, ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.add_source(Source_Builder(_src(keymap))
+                 .with_output_batch_size(obs).build()) \
+        .add(op).add_sink(Sink_Builder(coll.sink).build())
+    g.run()
+    return g
+
+
+def _map_builder(shape=None, key_capacity=NK):
+    b = (Map_TPU_Builder(
+            lambda row, st: ({"key": row["key"], "v": row["v"],
+                              "run": st + row["v"]}, st + row["v"]))
+         .with_state(np.float32(0)).with_key_by("key"))
+    if shape is not None or key_capacity != NK:
+        b = b.with_mesh(mesh_shape=shape, key_capacity=key_capacity)
+    return b
+
+
+def _map_oracle(keymap=None):
+    keymap = keymap or list(range(NK))
+    st, exp = {}, []
+    for i in range(N):
+        k, v = keymap[i % NK], float(i + 1)
+        st[k] = st.get(k, 0.0) + v
+        exp.append((k, v, st[k]))
+    return sorted(exp)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_map_mesh_reshape_invariance(shape):
+    """Stateful map over every factorization of the 8-device mesh ==
+    the arrival-order running state the single-chip semantics define —
+    resharding is a layout choice, not a semantics choice."""
+    coll = _Rows(("key", "v", "run"))
+    op = _map_builder(shape, key_capacity=NK).with_mesh(
+        mesh_shape=shape, key_capacity=NK).build()
+    _run(f"mm_{shape[0]}x{shape[1]}", op, coll)
+    assert coll.sorted == _map_oracle()
+
+
+def test_map_mesh_matches_single_chip():
+    """The mesh-sharded stateful map == the single-chip stateful
+    Map_TPU over the same stream (integer-valued float32 sums: exact).
+    The functor keeps the input schema — the single-chip plane's
+    ``with_fields`` contract."""
+    def running(row, st):
+        st2 = st + row["v"]
+        return {"key": row["key"], "v": st2}, st2
+
+    ref = _Rows(("key", "v"))
+    _run("mm_ref", Map_TPU_Builder(running).with_state(np.float32(0))
+         .with_key_by("key").build(), ref)
+    got = _Rows(("key", "v"))
+    _run("mm_mesh", Map_TPU_Builder(running).with_state(np.float32(0))
+         .with_key_by("key")
+         .with_mesh(mesh_shape=(4, 2), key_capacity=NK).build(), got)
+    assert got.sorted == ref.sorted
+
+
+def test_map_mesh_sparse_negative_keys():
+    """Arbitrary (sparse, negative) int64 keys route through the host
+    KeySlotMap: per-key running sums must group by the ORIGINAL key
+    identity. (The int64 key COLUMN itself truncates through the int32
+    device plane — a pre-existing device-plane property; original keys
+    ride the host metadata, as in the FFAT mesh plane.)"""
+    coll = _Rows(("v", "run"))
+    op = (Map_TPU_Builder(
+            lambda row, st: ({"key": row["key"], "v": row["v"],
+                              "run": st + row["v"]}, st + row["v"]))
+          .with_state(np.float32(0)).with_key_by("key")
+          .with_mesh(mesh_shape=(2, 4), key_capacity=NK).build())
+    _run("mm_sparse", op, coll, keymap=SPARSE_IDS)
+    exp = sorted((v, run) for _, v, run in _map_oracle(SPARSE_IDS))
+    assert coll.sorted == exp
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (2, 4)])
+def test_filter_mesh_reshape_invariance(shape):
+    """Stateful filter (keep every 2nd occurrence per key) over the
+    mesh == the single-chip per-key decision sequence."""
+    coll = _Rows(("key", "v"))
+    op = (Filter_TPU_Builder(lambda row, st: ((st + 1) % 2 == 0, st + 1))
+          .with_state(np.int32(0)).with_key_by("key")
+          .with_mesh(mesh_shape=shape, key_capacity=NK).build())
+    _run(f"fm_{shape[0]}x{shape[1]}", op, coll)
+    cnt, exp = {}, []
+    for i in range(N):
+        k, v = i % NK, float(i + 1)
+        cnt[k] = cnt.get(k, 0) + 1
+        if cnt[k] % 2 == 0:
+            exp.append((k, v))
+    assert coll.sorted == sorted(exp)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_reduce_mesh_matches_single_chip(shape):
+    """Keyed per-batch reduce over the mesh == single-chip Reduce_TPU:
+    one output per distinct key per batch, same values (integer-valued
+    float32 sums: exact)."""
+    ref = _Rows(("key", "v"))
+    _run("rm_ref", Reduce_TPU_Builder(
+        lambda a, b: {"v": a["v"] + b["v"]}).with_key_by("key").build(),
+        ref)
+    got = _Rows(("key", "v"))
+    _run(f"rm_{shape[0]}x{shape[1]}", Reduce_TPU_Builder(
+        lambda a, b: {"v": a["v"] + b["v"]}).with_key_by("key")
+        .with_mesh(mesh_shape=shape, key_capacity=NK).build(), got)
+    assert got.sorted == ref.sorted
+
+
+def test_mesh_key_capacity_guard():
+    coll = _Rows(("key", "v", "run"))
+    op = _map_builder((8, 1), key_capacity=3).with_mesh(
+        mesh_shape=(8, 1), key_capacity=3).build()
+    with pytest.raises(WindFlowError, match="key_capacity"):
+        _run("mm_cap", op, coll)
+
+
+# ---------------------------------------------------------------------------
+# builder validation
+# ---------------------------------------------------------------------------
+def test_mesh_builder_requires_state():
+    with pytest.raises(WindFlowError, match="with_state"):
+        (Map_TPU_Builder(lambda f: f).with_key_by("key")
+         .with_mesh().build())
+    with pytest.raises(WindFlowError, match="with_state"):
+        (Filter_TPU_Builder(lambda f: f).with_key_by("key")
+         .with_mesh().build())
+
+
+def test_mesh_builder_requires_keyby():
+    with pytest.raises(WindFlowError, match="with_key_by"):
+        (Reduce_TPU_Builder(lambda a, b: a).with_mesh().build())
+
+
+def test_mesh_builder_parallelism_exclusive():
+    with pytest.raises(WindFlowError, match="exclusive"):
+        (Map_TPU_Builder(lambda r, s: (r, s)).with_state(0.0)
+         .with_key_by("key").with_parallelism(2).with_mesh().build())
+
+
+# ---------------------------------------------------------------------------
+# mesh-plane refusals: rescale / governor SCALE rung / checkpoint
+# ---------------------------------------------------------------------------
+def test_mesh_ops_not_repartitionable():
+    """rescale()/autoscaler must refuse mesh ops via the standard
+    repartition_refusal plane — mesh parallelism is the mesh shape."""
+    from windflow_tpu.scaling.repartition import repartition_refusal
+    for op in (
+        _map_builder((8, 1)).build(),
+        Reduce_TPU_Builder(lambda a, b: a).with_key_by("key")
+            .with_mesh().build(),
+    ):
+        reason = repartition_refusal(op)
+        assert reason is not None and "mesh" in reason
+
+
+def test_rescale_refuses_mesh_op():
+    gate = threading.Event()
+
+    def src(shipper):
+        for i in range(200):
+            if i == 100:
+                gate.wait(10)
+            shipper.push({"key": i % NK, "v": float(i + 1)})
+    src.snapshot_position = lambda: 0
+    src.restore = lambda p: None
+
+    coll = _Rows(("key", "v", "run"))
+    g = PipeGraph("mm_rescale", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing()
+    op = _map_builder((8, 1)).with_name("mscan").build()
+    g.add_source(Source_Builder(src).with_output_batch_size(32).build()) \
+        .add(op).add_sink(Sink_Builder(coll.sink).build())
+    g.start()
+    try:
+        with pytest.raises(WindFlowError,
+                           match="not repartitionable.*mesh"):
+            g.rescale("mscan", 2)
+    finally:
+        gate.set()
+        g.wait_end()
+
+
+def test_governor_scale_rung_skips_mesh_ops():
+    """The overload governor's SCALE rung must never pick a mesh op —
+    its candidate set goes through repartition_refusal, so escalation
+    falls through to SHED instead of erroring mid-surge."""
+    gate = threading.Event()
+
+    def src(shipper):
+        for i in range(120):
+            if i == 60:
+                gate.wait(10)
+            shipper.push({"key": i % NK, "v": float(i + 1)})
+
+    coll = _Rows(("key", "v", "run"))
+    g = PipeGraph("mm_gov", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_slo(60_000.0)  # idle SLO: governor attaches, never engages
+    op = _map_builder((8, 1)).with_name("mscan").build()
+    g.add_source(Source_Builder(src).with_output_batch_size(32).build()) \
+        .add(op).add_sink(Sink_Builder(coll.sink).build())
+    g.start()
+    try:
+        gov = g._overload_governor
+        assert gov is not None
+        assert "mscan" not in gov._eligible_totals()
+        assert gov._try_scale() is False  # falls through toward SHED
+    finally:
+        gate.set()
+        g.wait_end()
+
+
+def test_checkpointing_refuses_non_snapshottable_mesh_op():
+    """The negotiation fallback: a mesh operator WITHOUT a sharded
+    snapshot path under with_checkpointing must refuse loudly at build —
+    a checkpoint that silently omits mesh state cannot restore."""
+    from windflow_tpu.mesh.ops_mesh import Map_Mesh
+
+    class LegacyMesh(Map_Mesh):
+        mesh_snapshot_capable = False
+
+    op = LegacyMesh(lambda r, s: (r, s), np.float32(0), "key",
+                    name="legacy_mesh", key_capacity=NK)
+    g = PipeGraph("mm_refuse", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing()
+    coll = _Rows(("key",))
+    g.add_source(Source_Builder(_src()).with_output_batch_size(32)
+                 .build()) \
+        .add(op).add_sink(Sink_Builder(coll.sink).build())
+    with pytest.raises(WindFlowError, match="legacy_mesh"):
+        g.run()
+
+
+def test_checkpointing_accepts_snapshottable_mesh_op():
+    """The in-tree mesh ops ARE snapshot-capable: the same graph with
+    the real operator runs under checkpointing."""
+    coll = _Rows(("key", "v", "run"))
+    g = PipeGraph("mm_ckpt_ok", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing()
+    g.add_source(Source_Builder(_src()).with_output_batch_size(64)
+                 .build()) \
+        .add(_map_builder((4, 2)).build()) \
+        .add_sink(Sink_Builder(coll.sink).build())
+    g.run()
+    assert coll.sorted == _map_oracle()
+
+
+# ---------------------------------------------------------------------------
+# sharded snapshot -> relayout -> restore (replica-level round-trip)
+# ---------------------------------------------------------------------------
+def test_scan_snapshot_relayout_roundtrip():
+    """Snapshot a mesh scan replica mid-stream, restore the blob into a
+    replica on a DIFFERENT factorization, continue the stream: results
+    equal an uninterrupted run (slot-row gather relayout)."""
+    import jax
+
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    def make_op(shape):
+        return _map_builder(shape).with_mesh(
+            mesh_shape=shape, key_capacity=NK).build()
+
+    schema = TupleSchema({"key": np.int32, "v": np.float32})
+
+    def batch(lo, hi):
+        keys = (np.arange(lo, hi) % NK).astype(np.int32)
+        vals = np.arange(lo + 1, hi + 1).astype(np.float32)
+        ts = np.arange(lo, hi).astype(np.int64)
+        return BatchTPU(
+            {"key": jax.device_put(keys), "v": jax.device_put(vals)},
+            ts, hi - lo, schema, wm=0, host_keys=keys)
+
+    class Sink:
+        def __init__(self):
+            self.rows = []
+
+        def emit_device_batch(self, b):
+            run = np.asarray(b.fields["run"])[:b.size]
+            keys = np.asarray(b.fields["key"])[:b.size]
+            self.rows.extend(zip(keys.tolist(), run.tolist()))
+
+    # uninterrupted reference on (8, 1)
+    ref_op = make_op((8, 1))
+    ref_op.build_replicas()
+    ref = ref_op.replicas[0]
+    ref.emitter = Sink()
+    ref.process_device_batch(batch(0, 96))
+    ref.process_device_batch(batch(96, 192))
+
+    # snapshot after the first half on (8, 1)
+    op1 = make_op((8, 1))
+    op1.build_replicas()
+    r1 = op1.replicas[0]
+    r1.emitter = Sink()
+    r1.process_device_batch(batch(0, 96))
+    blob = r1.snapshot_state()
+    assert blob["mesh_scan"]["table_shards"] is not None
+    assert len(blob["mesh_scan"]["table_shards"]) == 8  # per-shard blocks
+
+    # restore onto (2, 4) and continue
+    op2 = make_op((2, 4))
+    op2.build_replicas()
+    r2 = op2.replicas[0]
+    r2.emitter = Sink()
+    r2.restore_state(blob)
+    r2.process_device_batch(batch(96, 192))
+    assert sorted(r2.emitter.rows) == sorted(ref.emitter.rows[96:])
+
+
+def test_scan_snapshot_passthrough_before_first_batch():
+    """Restore then snapshot BEFORE any batch: the blob passes through
+    unchanged (an epoch committing right after a restore must not lose
+    the restored table)."""
+    op1 = _map_builder((8, 1)).build()
+    op1.build_replicas()
+    r1 = op1.replicas[0]
+    import jax
+
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.schema import TupleSchema
+    schema = TupleSchema({"key": np.int32, "v": np.float32})
+    keys = (np.arange(64) % NK).astype(np.int32)
+    b = BatchTPU({"key": jax.device_put(keys),
+                  "v": jax.device_put(np.ones(64, np.float32))},
+                 np.arange(64, dtype=np.int64), 64, schema, wm=0,
+                 host_keys=keys)
+
+    class Drop:
+        def emit_device_batch(self, b):
+            pass
+    r1.emitter = Drop()
+    r1.process_device_batch(b)
+    blob = r1.snapshot_state()
+
+    op2 = _map_builder((4, 2)).build()
+    op2.build_replicas()
+    r2 = op2.replicas[0]
+    r2.restore_state(blob)
+    blob2 = r2.snapshot_state()
+    assert blob2["mesh_scan"] == blob["mesh_scan"]

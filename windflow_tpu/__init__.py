@@ -8,12 +8,17 @@ are staged into TPU HBM as columnar arrays, per-batch operator functors are
 JIT-compiled XLA programs, keyed shuffles become sort/segment programs, and
 the FlatFAT sliding-window tree is a batched segment tree in HBM
 (``Ffat_Windows_TPU``). Multi-chip scale-out (a surface the single-node
-reference lacks) shards keyed state over a ``jax.sharding.Mesh``.
+reference lacks) shards the whole keyed-state plane over a
+``jax.sharding.Mesh`` (``windflow_tpu.mesh``: sharded FFAT windows,
+stateful Map/Filter grid tables, keyed Reduce — KEYBY lowered to
+in-program ``lax.all_to_all`` collectives, with sharded
+checkpoint/restore onto any mesh factorization).
 
 Import layering: ``import windflow_tpu`` pulls only the CPU plane (no jax);
 ``windflow_tpu.tpu`` loads the device plane lazily. Subpackages:
-``windflow_tpu.tpu`` (device operators), ``windflow_tpu.parallel``
-(multi-chip mesh), ``windflow_tpu.persistent`` (out-of-core keyed state),
+``windflow_tpu.tpu`` (device operators), ``windflow_tpu.mesh``
+(the mesh execution plane; ``windflow_tpu.parallel`` is its compat
+shim), ``windflow_tpu.persistent`` (out-of-core keyed state),
 ``windflow_tpu.kafka`` (connectors), ``windflow_tpu.monitoring``.
 """
 

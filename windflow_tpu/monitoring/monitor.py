@@ -200,6 +200,26 @@ _PROM_SCALARS = (
     ("windflow_shed_bytes_total", "counter",
      "Approximate bytes shed by source admission control",
      "Shed_bytes", 1),
+    # mesh execution plane (windflow_tpu.mesh): present only on replicas
+    # that drive a device mesh (StatsRecord omits Mesh_* elsewhere, so
+    # these families carry series only where a mesh exists)
+    ("windflow_mesh_devices", "gauge",
+     "Devices of the mesh this replica drives (0 series absent = not a "
+     "mesh operator)", "Mesh_devices", 1),
+    ("windflow_mesh_steps_total", "counter",
+     "Sharded shard_map steps dispatched over the mesh", "Mesh_steps", 1),
+    ("windflow_mesh_shuffle_bytes_total", "counter",
+     "Bytes moved by the in-program all_to_all KEYBY shuffle",
+     "Mesh_shuffle_bytes", 1),
+    ("windflow_mesh_step_seconds_total", "counter",
+     "Host-observed time dispatching sharded mesh steps",
+     "Mesh_step_usec_total", 1e-6),
+    ("windflow_mesh_shard_occupancy", "gauge",
+     "Max key-slot occupancy of any mesh shard (block-owner mapping)",
+     "Mesh_shard_occupancy", 1),
+    ("windflow_mesh_shard_skew", "gauge",
+     "Max/mean shard occupancy (1.0 = even key spread)",
+     "Mesh_shard_skew", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)

@@ -54,6 +54,15 @@ class StatsRecord:
         # by admission control at the SOURCE boundary (before barriers
         # and the exactly-once plane — accounted, never silently lost)
         "shed_records", "shed_bytes",
+        # mesh execution plane (windflow_tpu.mesh): per-shard visibility
+        # for operators whose parallelism is a device mesh — steps run,
+        # bytes through the in-program all_to_all shuffle, host-observed
+        # step time, and slot occupancy/skew of the block-owner mapping.
+        # mesh_devices == 0 marks a non-mesh replica; to_dict then omits
+        # the Mesh_* keys so /metrics carries mesh series only where a
+        # mesh exists
+        "mesh_devices", "mesh_steps", "mesh_shuffle_bytes",
+        "mesh_step_total_us", "mesh_shard_occupancy", "mesh_shard_skew",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -128,6 +137,12 @@ class StatsRecord:
         self.kafka_reconnects = 0
         self.shed_records = 0
         self.shed_bytes = 0
+        self.mesh_devices = 0
+        self.mesh_steps = 0
+        self.mesh_shuffle_bytes = 0
+        self.mesh_step_total_us = 0.0
+        self.mesh_shard_occupancy = 0
+        self.mesh_shard_skew = 0.0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -254,6 +269,18 @@ class StatsRecord:
         self.compile_last_us = us
         self.compile_last_signature = signature
 
+    # -- mesh execution plane (windflow_tpu.mesh) -----------------------------
+    def note_mesh_step(self, us: float, shuffle_bytes: int) -> None:
+        """One sharded step: host-observed dispatch time + the bytes its
+        in-program all_to_all moved (every tuple column crosses the
+        shuffle exactly once per step)."""
+        self.mesh_steps += 1
+        self.mesh_step_total_us += us
+        self.mesh_shuffle_bytes += shuffle_bytes
+        if self.recorder is not None:
+            self.recorder.event("mesh:step", us,
+                                {"bytes": shuffle_bytes})
+
     # -- overload protection (windflow_tpu.overload) --------------------------
     def note_shed(self, n: int, nbytes: int) -> None:
         """Records shed by source admission control (never emitted, so
@@ -339,6 +366,16 @@ class StatsRecord:
             "Worker_last_error": self.worker_last_error,
             "isTerminated": self.is_terminated,
         }
+        # -- mesh execution plane (mesh replicas only: a Mesh_* series on
+        # every CPU replica would be noise — /metrics renders these only
+        # where rep.get(field) exists) ---------------------------------------
+        if self.mesh_devices > 0:
+            d["Mesh_devices"] = self.mesh_devices
+            d["Mesh_steps"] = self.mesh_steps
+            d["Mesh_shuffle_bytes"] = self.mesh_shuffle_bytes
+            d["Mesh_step_usec_total"] = round(self.mesh_step_total_us, 1)
+            d["Mesh_shard_occupancy"] = self.mesh_shard_occupancy
+            d["Mesh_shard_skew"] = self.mesh_shard_skew
         # -- queue / backpressure plane (0s for sources and fused chains) ---
         ch = self.input_channel
         d["Queue_len"] = len(ch) if ch is not None else 0

@@ -64,6 +64,12 @@ def repartition_refusal(op) -> Optional[str]:
     if op.op_type == OpType.SOURCE:
         return ("source replicas are independent generators; their replay "
                 "cursors are positions, not keyed state")
+    if getattr(op, "is_mesh", False):
+        return ("mesh-sharded operators parallelize over the device mesh, "
+                "not the replica count — one host replica drives every "
+                "chip; to change capacity, checkpoint and restore with a "
+                "different with_mesh(mesh_shape=...) (sharded restore "
+                "relayouts the key axis across the new factorization)")
     if getattr(op, "exactly_once", False):
         return ("exactly-once sinks own per-replica transaction logs "
                 "(staged epoch segments / transactional producer ids); "

@@ -403,6 +403,26 @@ class PipeGraph:
                 op._dlq = pol.dlq if pol.dlq is not None \
                     else self.dead_letter_queue()
 
+    def _negotiate_mesh_checkpoint(self) -> None:
+        """Guarantee negotiation for the mesh plane (first ``_build``):
+        a mesh operator without a sharded snapshot/restore path under
+        checkpointing would produce checkpoints that silently omit its
+        device-mesh state — and could never restore it. Refuse loudly
+        instead. Every in-tree mesh operator is snapshot-capable; this
+        is the standing fallback for any future mesh op that is not."""
+        if not self._ckpt_enabled:
+            return
+        for op in self._ops:
+            if getattr(op, "is_mesh", False) \
+                    and not getattr(op, "mesh_snapshot_capable", False):
+                raise WindFlowError(
+                    f"with_checkpointing: mesh operator {op.name!r} "
+                    f"({type(op).__name__}) has no sharded "
+                    "snapshot/restore path — a checkpoint would silently "
+                    "omit its device-mesh state and a restore could not "
+                    "rebuild it; run this graph without checkpointing/"
+                    "supervision or use a snapshot-capable mesh operator")
+
     def _negotiate_exactly_once(self) -> None:
         """Guarantee negotiation (first ``_build``): flip graph-wide
         exactly-once onto every sink, then verify every exactly-once
@@ -813,6 +833,7 @@ class PipeGraph:
         # the refusal
         self._negotiate_exactly_once()
         self._negotiate_error_policies()
+        self._negotiate_mesh_checkpoint()
         for s in self._stages:
             for op in s.ops:
                 op.configure(self.execution_mode, self.time_policy)

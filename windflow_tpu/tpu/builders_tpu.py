@@ -22,7 +22,49 @@ class _TPUBuilderMixin:
         return self
 
 
-class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+class _MeshBuilderMixin:
+    """``with_mesh`` for the keyed device operators: shard the operator's
+    keyed-state plane over a ``('key','data')`` device mesh
+    (``windflow_tpu.mesh``) instead of a single chip."""
+
+    _mesh_cfg: Optional[dict] = None
+
+    def with_mesh(self, n_devices: Optional[int] = None,
+                  mesh_shape: Optional[tuple] = None,
+                  local_batch: Optional[int] = None,
+                  key_capacity: int = 1024):
+        """``build()`` returns the mesh-sharded operator (``Map_Mesh`` /
+        ``Filter_Mesh`` / ``Reduce_Mesh``): ONE host replica drives every
+        device, the KEYBY shuffle runs in-program as a bucket-by-owner +
+        ``lax.all_to_all`` collective, and per-key state is block-sharded
+        over the devices. ``mesh_shape=(ka, da)`` forces the
+        factorization (results are invariant under reshape); default
+        uses every visible device. ARBITRARY int64 keys densify to
+        ``key_capacity`` slots via a host KeySlotMap (more distinct keys
+        raise loudly). Mesh operators refuse ``rescale()`` — parallelism
+        is the mesh shape; to change capacity, checkpoint and restore
+        with a different ``with_mesh(mesh_shape=...)``."""
+        self._mesh_cfg = {"n_devices": n_devices, "mesh_shape": mesh_shape,
+                          "local_batch": local_batch,
+                          "key_capacity": key_capacity}
+        return self
+
+    def _mesh_guard(self, what: str) -> None:
+        if self._parallelism != 1:
+            raise WindFlowError(
+                f"{what}: with_mesh and with_parallelism are exclusive — "
+                "the mesh IS the parallelism (one host replica drives "
+                "every chip)")
+        if self._output_batch_size:
+            raise WindFlowError(
+                f"{what}: with_output_batch_size does not apply to the "
+                "mesh plane (batches pad to the mesh's global batch)")
+        if self._key_extractor is None:
+            raise WindFlowError(f"{what}: with_mesh requires with_key_by "
+                                "(the mesh shards the KEYED plane)")
+
+
+class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin, _MeshBuilderMixin):
     _default_name = "map_tpu"
 
     def __init__(self, func: Callable) -> None:
@@ -40,13 +82,21 @@ class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         if self._state_init is not None and self._key_extractor is None:
             raise WindFlowError("Map_TPU_Builder: with_state requires "
                                 "with_key_by")
+        if self._mesh_cfg is not None:
+            from ..mesh.ops_mesh import Map_Mesh
+            self._mesh_guard("Map_TPU_Builder")
+            return self._finish(Map_Mesh(
+                self._func, self._state_init, self._key_extractor,
+                self._name if self._name != self._default_name
+                else "map_mesh", schema=self._schema, **self._mesh_cfg))
         return self._finish(Map_TPU(self._func, self._name, self._parallelism,
                                     self._routing, self._key_extractor,
                                     self._output_batch_size, self._schema,
                                     self._state_init))
 
 
-class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin,
+                         _MeshBuilderMixin):
     _default_name = "filter_tpu"
 
     def __init__(self, pred: Callable) -> None:
@@ -64,6 +114,13 @@ class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
         if self._state_init is not None and self._key_extractor is None:
             raise WindFlowError("Filter_TPU_Builder: with_state requires "
                                 "with_key_by")
+        if self._mesh_cfg is not None:
+            from ..mesh.ops_mesh import Filter_Mesh
+            self._mesh_guard("Filter_TPU_Builder")
+            return self._finish(Filter_Mesh(
+                self._func, self._state_init, self._key_extractor,
+                self._name if self._name != self._default_name
+                else "filter_mesh", schema=self._schema, **self._mesh_cfg))
         return self._finish(Filter_TPU(self._func, self._name,
                                        self._parallelism, self._routing,
                                        self._key_extractor,
@@ -71,7 +128,8 @@ class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
                                        self._state_init))
 
 
-class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
+class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin,
+                         _MeshBuilderMixin):
     _default_name = "reduce_tpu"
 
     def __init__(self, combine: Callable) -> None:
@@ -86,6 +144,13 @@ class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
             # mislead (the reference reduce has no broadcast form either)
             raise WindFlowError("Reduce_TPU_Builder: withBroadcast is not "
                                 "supported (use withKeyBy or forward)")
+        if self._mesh_cfg is not None:
+            from ..mesh.ops_mesh import Reduce_Mesh
+            self._mesh_guard("Reduce_TPU_Builder")
+            return self._finish(Reduce_Mesh(
+                self._func, self._key_extractor,
+                self._name if self._name != self._default_name
+                else "reduce_mesh", schema=self._schema, **self._mesh_cfg))
         # without withKeyBy this is the GLOBAL per-batch reduce
         return self._finish(Reduce_TPU(self._func, self._key_extractor,
                                        self._name, self._parallelism,
@@ -169,7 +234,7 @@ class Ffat_Windows_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin):
             raise WindFlowError("Ffat_Windows_TPU_Builder: withKeyBy "
                                 "is mandatory")
         if getattr(self, "_mesh_cfg", None) is not None:
-            from .ffat_mesh import Ffat_Windows_Mesh
+            from ..mesh.ffat_mesh import Ffat_Windows_Mesh
             if self._parallelism != 1:
                 raise WindFlowError(
                     "Ffat_Windows_TPU_Builder: with_mesh and "
