@@ -1392,9 +1392,37 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
 
     fused_tps = _run_op_config(_fused_chain_op, 64, 12, repeats=REPEATS,
                                batch_size=16384)
+
+    def _megabatch_chain_op():
+        # same fused chain behind a WF_MEGABATCH=16 dispatch queue: the
+        # overflow pops run 16 queued batches as ONE lax.scan dispatch
+        # (runtime/dispatch.py); 48 batches/repeat so the 16-deep queue
+        # overflows and the steady window is scan groups, not singles
+        from windflow_tpu.runtime.dispatch import DeviceDispatchQueue
+        from windflow_tpu.tpu.fused_ops import FusedTPUReplica
+        from windflow_tpu.tpu.ops_tpu import Filter_TPU
+
+        class _MBChain:
+            def build_replicas(self):
+                ops = [Map_TPU(lambda f: {**f, "value": f["value"] * 3
+                                          + f["key"]}, name="bench_bm1"),
+                       Filter_TPU(lambda f: (f["value"] % 2) == 0,
+                                  name="bench_bf1"),
+                       Map_TPU(lambda f: {**f, "value": f["value"] + 1},
+                               name="bench_bm2")]
+                r = FusedTPUReplica(ops, 0)
+                r.dispatch = DeviceDispatchQueue(stats=r.stats, depth=16,
+                                                 megabatch=16)
+                self.replicas = [r]
+
+        return _MBChain()
+
+    mb_tps = _run_op_config(_megabatch_chain_op, 64, 48, repeats=REPEATS,
+                            batch_size=16384)
     _log(f"stateful map {smap_tps:,.0f} t/s, "
          f"keyed reduce {kred_tps:,.0f} t/s, "
-         f"fused 3-op chain {fused_tps:,.0f} t/s (16k)")
+         f"fused 3-op chain {fused_tps:,.0f} t/s (16k), "
+         f"megabatch x16 {mb_tps:,.0f} t/s (16k)")
 
     metric = "ffat_sliding_window_tuples_per_sec_per_chip"
     if fallback or platform == "cpu":
@@ -1422,6 +1450,7 @@ def _measure_and_report(platform: str, fallback: bool) -> None:
         "stateful_map_tuples_per_sec": round(smap_tps, 1),
         "keyed_reduce_tuples_per_sec": round(kred_tps, 1),
         "fused_chain_tuples_per_sec": round(fused_tps, 1),
+        "megabatch_tuples_per_sec": round(mb_tps, 1),
     }
     if os.environ.get("WF_BENCH_CONTENDED") == "1":
         # measured while another relay client (watcher probe/session or

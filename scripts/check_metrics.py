@@ -89,6 +89,12 @@ REQUIRED_FAMILIES = (
     "windflow_mesh_step_seconds_total",
     "windflow_mesh_shard_occupancy",
     "windflow_mesh_shard_skew",
+    # megabatch scan loop (per-replica scalars: present with value 0
+    # when WF_MEGABATCH is off or the replica is not a fused chain)
+    "windflow_megabatch_loops_total",
+    "windflow_megabatch_batches_per_loop_avg",
+    "windflow_megabatch_max",
+    "windflow_programs_per_batch",
 )
 
 _SAMPLE_RE = re.compile(

@@ -686,6 +686,97 @@ def bench_fusion() -> None:
            (st.dispatch_commit_total_us - commit0) / NB, "usec")
 
 
+def bench_megabatch() -> None:
+    """--megabatch: the device-resident scan loop (``WF_MEGABATCH=K``)
+    on the fused 3-op Map -> Filter -> Map chain at K in {1, 4, 16},
+    interleaved best-of-6. Reports tuples/s per K plus the
+    host-dispatch amortization: programs-per-batch / host-dispatches-
+    per-batch measured over the STEADY window (before the EOS drain,
+    which always degrades to K=1 singles) — at K=16 every overflow pop
+    runs 16 queued batches as one ``lax.scan`` dispatch, so the steady
+    window must show <= 1/16 dispatches per batch."""
+    import jax
+
+    from windflow_tpu.runtime.dispatch import DeviceDispatchQueue
+    from windflow_tpu.tpu.batch import BatchTPU
+    from windflow_tpu.tpu.fused_ops import FusedTPUReplica
+    from windflow_tpu.tpu.ops_tpu import Filter_TPU, Map_TPU
+    from windflow_tpu.tpu.schema import TupleSchema
+
+    B, NB, WARMUP, ROUNDS = 8192, 64, 8, 6
+    KS = (1, 4, 16)
+    schema = TupleSchema({"key": np.int32, "value": np.int32})
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(NB + WARMUP):
+        cols = {"key": jax.device_put(
+                    rng.integers(0, 64, B).astype(np.int32)),
+                "value": jax.device_put(
+                    rng.integers(0, 1000, B).astype(np.int32))}
+        batches.append(BatchTPU(cols, np.arange(B, dtype=np.int64), B,
+                                schema))
+
+    class _Sink:
+        def __init__(self):
+            self.tuples = 0
+
+        def emit_device_batch(self, b):
+            self.tuples += b.size
+
+        def set_stats(self, s):
+            pass
+
+    def mk_replica(k):
+        ops = [Map_TPU(lambda f: {**f, "value": f["value"] * 3 + f["key"]},
+                       name="m1"),
+               Filter_TPU(lambda f: (f["value"] % 2) == 0, name="f1"),
+               Map_TPU(lambda f: {**f, "value": f["value"] + 1},
+                       name="m2")]
+        fr = FusedTPUReplica(ops, 0)
+        fr.dispatch = DeviceDispatchQueue(stats=fr.stats, depth=max(2, k),
+                                          megabatch=k)
+        sink = _Sink()
+        fr.set_emitter(sink)
+        return fr, sink
+
+    replicas = {k: mk_replica(k) for k in KS}
+    for fr, _sink in replicas.values():  # warm every program shape
+        for bt in batches[:WARMUP]:
+            fr.handle_msg(0, bt)
+        fr.dispatch.drain()
+
+    best = {k: 0.0 for k in KS}
+    dpb = {k: 1.0 for k in KS}
+    for _ in range(ROUNDS):  # interleaved: drift hits every K equally
+        for k in KS:
+            fr, _sink = replicas[k]
+            progs0 = fr.stats.device_programs_run
+            t0 = time.perf_counter()
+            for bt in batches[WARMUP:]:
+                fr.handle_msg(0, bt)
+            # steady window: overflow pops only (the final drain below
+            # is the EOS ordering point and always runs singles)
+            progs = fr.stats.device_programs_run - progs0
+            committed = NB - len(fr.dispatch)
+            fr.dispatch.drain()
+            wall = time.perf_counter() - t0
+            best[k] = max(best[k], NB * B / wall)
+            if committed:
+                dpb[k] = progs / committed
+
+    counts = {k: s.tuples for k, (_f, s) in replicas.items()}
+    assert len(set(counts.values())) == 1, counts  # exact across K
+
+    for k in KS:
+        report(f"megabatch_k{k}_tuples_per_sec", best[k])
+    print(json.dumps({"bench": "megabatch_host_dispatches_per_batch",
+                      **{f"k{k}": round(dpb[k], 4) for k in KS}}))
+    print(json.dumps({"bench": "megabatch_k16_vs_k1",
+                      "value": round(best[16] / best[1], 3)
+                      if best[1] else 0.0,
+                      "unit": "speedup"}))
+
+
 def bench_flightrec() -> None:
     """--flightrec: flight-recorder overhead (monitoring/flightrec.py)
     on the per-tuple CPU plane at {off, on (4096-event ring), on with a
@@ -1160,6 +1251,9 @@ def main() -> None:
     if "--fusion" in sys.argv[1:]:
         bench_fusion()
         return
+    if "--megabatch" in sys.argv[1:]:
+        bench_megabatch()
+        return
     if "--flightrec" in sys.argv[1:]:
         bench_flightrec()
         return
@@ -1173,6 +1267,7 @@ def main() -> None:
     bench_exit_pipeline()
     bench_dispatch()
     bench_fusion()
+    bench_megabatch()
     bench_cpu_plane()
     bench_latency()
     bench_flightrec()

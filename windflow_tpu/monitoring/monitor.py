@@ -130,6 +130,19 @@ _PROM_SCALARS = (
     ("windflow_dispatch_stalls_total", "counter",
      "Forced ordering-point drains with commits in flight",
      "Dispatch_readback_stalls", 1),
+    ("windflow_megabatch_loops_total", "counter",
+     "Megabatch scan loops dispatched (K batches per loop)",
+     "Megabatch_loops", 1),
+    ("windflow_megabatch_batches_per_loop_avg", "gauge",
+     "Mean batches retired per megabatch scan loop",
+     "Megabatch_batches_per_loop_avg", 1),
+    ("windflow_megabatch_max", "gauge",
+     "Widest megabatch group committed by one scan dispatch",
+     "Megabatch_max", 1),
+    ("windflow_programs_per_batch", "gauge",
+     "Device programs dispatched per prepped batch (1.0 = fused "
+     "baseline, < 1.0 = megabatch amortization)",
+     "Programs_per_batch", 1),
     ("windflow_queue_occupancy", "gauge",
      "Input channel occupancy (messages)", "Queue_len", 1),
     ("windflow_queue_capacity", "gauge",

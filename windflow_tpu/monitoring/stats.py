@@ -34,6 +34,11 @@ class StatsRecord:
         "dispatch_host_prep_us", "dispatch_commit_us",
         "dispatch_host_prep_total_us", "dispatch_commit_total_us",
         "dispatch_batches", "dispatch_stalls", "dispatch_depth_max",
+        # megabatch scan loop (runtime/dispatch.py + tpu/fused_ops.py):
+        # grouped dispatches (loops), batches committed through them,
+        # and the widest group observed — Programs_per_batch in to_dict
+        # derives the amortization from device_programs_run
+        "megabatch_loops", "megabatch_batches", "megabatch_max",
         # aligned-barrier checkpointing (windflow_tpu.checkpoint):
         # per-replica snapshot count/duration/size + barrier-alignment
         # stall time (multi-input workers buffering behind the barrier)
@@ -122,6 +127,9 @@ class StatsRecord:
         self.dispatch_batches = 0
         self.dispatch_stalls = 0  # forced ordering-point drains
         self.dispatch_depth_max = 0
+        self.megabatch_loops = 0
+        self.megabatch_batches = 0
+        self.megabatch_max = 0
         self.checkpoints_taken = 0
         self.checkpoint_snapshot_total_us = 0.0
         self.checkpoint_last_snapshot_us = 0.0
@@ -236,6 +244,16 @@ class StatsRecord:
         if self.recorder is not None:
             self.recorder.event("commit", us)
 
+    def note_megabatch(self, k: int, us: float) -> None:
+        """One megabatch scan loop: K same-signature batches committed
+        through ONE program dispatch (``FusedTPUReplica._run_megabatch``)."""
+        self.megabatch_loops += 1
+        self.megabatch_batches += k
+        if k > self.megabatch_max:
+            self.megabatch_max = k
+        if self.recorder is not None:
+            self.recorder.event("megabatch:scan", us, k)
+
     def note_dispatch_depth(self, depth: int) -> None:
         if depth > self.dispatch_depth_max:
             self.dispatch_depth_max = depth
@@ -331,6 +349,18 @@ class StatsRecord:
             "Dispatch_batches": self.dispatch_batches,
             "Dispatch_readback_stalls": self.dispatch_stalls,
             "Dispatch_queue_depth_max": self.dispatch_depth_max,
+            # megabatch scan loop (0s with WF_MEGABATCH off or on
+            # non-fused replicas; Programs_per_batch == 1.0 is the
+            # un-amortized fused baseline, < 1.0 means the scan loop is
+            # retiring multiple batches per dispatch)
+            "Megabatch_loops": self.megabatch_loops,
+            "Megabatch_batches_per_loop_avg": round(
+                self.megabatch_batches / self.megabatch_loops, 2)
+                if self.megabatch_loops else 0.0,
+            "Megabatch_max": self.megabatch_max,
+            "Programs_per_batch": round(
+                self.device_programs_run / self.dispatch_batches, 3)
+                if self.dispatch_batches else 0.0,
             "Checkpoint_snapshots": self.checkpoints_taken,
             "Checkpoint_snapshot_usec_total": round(
                 self.checkpoint_snapshot_total_us, 1),

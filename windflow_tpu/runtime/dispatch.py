@@ -34,6 +34,22 @@ against control-plane state the failed batch already advanced, so
 re-running them after the error would emit from an inconsistent forest;
 the error itself unwinds the worker (drain-inputs + emergency EOS).
 
+MEGABATCH (``WF_MEGABATCH=K``, default 1 = off): when the queue
+overflows, a FRONT run of commits carrying the same ``scan_sig`` (same
+fused chain, same program signature, same capacity bucket —
+``tpu/fused_ops.py`` attaches the attribute) is popped as ONE group and
+handed to the commits' ``scan_runner``, which executes all of them in a
+single jitted ``lax.scan`` over the chain program: K batches, ONE host
+dispatch. Only the largest power-of-two prefix of the run groups (so
+the set of compiled scan programs stays enumerable for the pre-warm);
+mixed-signature, non-fused, or lone commits run as singles. ``drain``
+always runs singles, so every ordering point — punctuation, EOS,
+checkpoint snapshot, device-state access, error unwind — degrades to
+K=1 and the alignment/exactly-once/rescale semantics are untouched.
+Commits still run strictly in submission order either way (the scan
+body IS the chain program, threading the same carried state
+batch-to-batch).
+
 Per-stage instrumentation lands in the replica's ``StatsRecord``
 (``Dispatch_host_prep_usec`` / ``Dispatch_commit_usec`` EWMAs + totals,
 forced-drain stall count, max queue depth) so the host-prep/device split
@@ -64,11 +80,29 @@ def dispatch_depth(default: int = _DEFAULT_DEPTH) -> int:
         return default
 
 
+def megabatch_k(default: int = 1) -> int:
+    """The configured megabatch width (``WF_MEGABATCH``, default 1;
+    0/1 = off — every commit runs as its own program). Malformed values
+    fall back to the default."""
+    try:
+        return max(1, int(os.environ.get("WF_MEGABATCH", str(default))))
+    except ValueError:
+        return default
+
+
 class DeviceDispatchQueue:
     """Bounded FIFO of deferred device-commit thunks (see module doc)."""
 
-    def __init__(self, stats=None, depth: Optional[int] = None) -> None:
+    def __init__(self, stats=None, depth: Optional[int] = None,
+                 megabatch: Optional[int] = None) -> None:
         self.depth = dispatch_depth() if depth is None else max(0, depth)
+        self.megabatch = (megabatch_k() if megabatch is None
+                          else max(1, megabatch))
+        # a K-wide megabatch can only form if K prepped commits can sit
+        # in the queue; the scan loop implies at least that much lag.
+        # depth 0 (synchronous) wins: commits never queue at all.
+        if self.depth > 0 and self.megabatch > 1:
+            self.depth = max(self.depth, self.megabatch)
         self.stats = stats
         # jax.profiler span label so captured device traces line up with
         # the Dispatch_commit stats (prep span lives in the replica)
@@ -105,7 +139,7 @@ class DeviceDispatchQueue:
             if rec is not None:
                 rec.event("dispatch_submit", 0.0, len(self._q))
         while len(self._q) > self.depth:
-            self._run(*self._q.popleft())
+            self._pop_run()
 
     def drain(self, forced: bool = False) -> None:
         """Commit everything in flight. ``forced=True`` marks an
@@ -131,6 +165,50 @@ class DeviceDispatchQueue:
         self._q.clear()
 
     # ------------------------------------------------------------------
+    def _pop_run(self) -> None:
+        """Overflow pop: commit the oldest entry — or, with megabatching
+        on, the longest same-signature power-of-two FRONT run as one
+        grouped scan dispatch. Popping never reorders: the group is a
+        contiguous prefix and the scan walks it in submission order."""
+        q = self._q
+        k = self.megabatch
+        sig = (getattr(q[0][0], "scan_sig", None) if k > 1 else None)
+        if sig is None:
+            self._run(*q.popleft())
+            return
+        run = 1
+        while run < k and run < len(q) \
+                and getattr(q[run][0], "scan_sig", None) == sig:
+            run += 1
+        g = 1 << (run.bit_length() - 1)  # largest power of two <= run
+        if g < 2:
+            self._run(*q.popleft())
+            return
+        self._run_group([q.popleft() for _ in range(g)])
+
+    def _run_group(self, entries) -> None:
+        """Run a same-signature group through the commits' scan runner
+        (``FusedTPUReplica._run_megabatch``): one program, one dispatch,
+        len(entries) batches. Error unwind matches ``_run`` — a failed
+        group aborts the remaining pipeline entries."""
+        t0 = time.perf_counter()
+        if self.stats is not None:
+            rec = self.stats.recorder
+            if rec is not None:
+                for _commit, enq_t in entries:
+                    rec.event("dispatch_wait", (t0 - enq_t) * 1e6)
+        commits = [commit for commit, _t in entries]
+        try:
+            with device_span(self._span_commit):
+                commits[0].scan_runner(commits)
+        except BaseException:
+            self.abort()
+            raise
+        finally:
+            if self.stats is not None:
+                self.stats.note_dispatch_commit(
+                    (time.perf_counter() - t0) * 1e6)
+
     def _run(self, commit: Callable[[], None],
              enq_t: Optional[float] = None) -> None:
         t0 = time.perf_counter()

@@ -839,11 +839,13 @@ class PipeGraph:
                 op.configure(self.execution_mode, self.time_policy)
             if s.is_fused_tpu:
                 # chained device stage: ONE fused replica per slot runs
-                # the whole chain as a single XLA program (fused_ops.py).
-                # Every sub-op aliases the fused replica list so edge
-                # wiring (first_op/last_op.replicas) stays uniform.
-                from ..tpu.fused_ops import FusedTPUReplica
-                fused = [FusedTPUReplica(s.ops, i)
+                # the whole chain as a single XLA program (fused_ops.py;
+                # the factory picks the window-terminated variant when
+                # the chain ends in Ffat_Windows_TPU). Every sub-op
+                # aliases the fused replica list so edge wiring
+                # (first_op/last_op.replicas) stays uniform.
+                from ..tpu.fused_ops import make_fused_replica
+                fused = [make_fused_replica(s.ops, i)
                          for i in range(s.parallelism)]
                 label = s.describe()
                 for op in s.ops:

@@ -125,8 +125,9 @@ class Stage:
         if self.is_sink:
             return "tail stage already ends in a sink"
         if op.parallelism != self.parallelism:
-            return (f"parallelism mismatch ({op.parallelism} vs "
-                    f"{self.parallelism})")
+            return (f"mixed parallelism ({op.parallelism} vs "
+                    f"{self.parallelism}) needs a re-shard between the "
+                    "stages")
         tail_tpu = getattr(self.last_op, "is_tpu", False)
         cand_tpu = getattr(op, "is_tpu", False)
         if tail_tpu or cand_tpu:
@@ -147,8 +148,12 @@ class Stage:
     def _tpu_fusion_refusal(self, op: BasicOperator) -> Optional[str]:
         """Device-chain fusion legality: consecutive FORWARD (or
         key-compatible KEYBY) same-parallelism device transforms fuse
-        into one XLA program; a global Reduce_TPU may terminate the
-        chain. Everything else keeps its own stage."""
+        into one XLA program; a terminator role (global or keyed
+        Reduce_TPU, Ffat window) may END the chain — the keyed/window
+        terminators additionally require their KEYBY shuffle to be the
+        identity (single replica or a key-compatible keyed entry), and
+        the window terminator a STATELESS prefix. Everything else keeps
+        its own stage."""
         if not tpu_fusion_enabled():
             return "device-chain fusion disabled (WF_TPU_FUSION=0)"
         def _guarded(o):
@@ -159,25 +164,55 @@ class Stage:
             # fused program cannot attribute the error to a sub-op
             return ("error policy set — poison-record bisection needs "
                     "the operator's own program boundary")
-        if getattr(self.last_op, "fusion_role", None) == "terminator":
+        last_role = getattr(self.last_op, "fusion_role", None)
+        if last_role == "terminator":
             return (f"{self.last_op.name} (global Reduce_TPU) already "
                     "terminates the fused chain")
+        if last_role == "keyed_terminator":
+            return (f"{self.last_op.name} (keyed Reduce_TPU) already "
+                    "terminates the fused chain")
+        if last_role == "window_terminator":
+            return (f"{self.last_op.name} is a window non-terminal "
+                    "position — the window step already terminates the "
+                    "fused chain (it changes the row domain: tuples -> "
+                    "fired windows)")
         if any(getattr(o, "fusion_role", None) is None for o in self.ops):
             return (f"{self.first_op.name} has no composable device "
-                    "kernel (window/mesh operators own their stage)")
+                    "kernel (mesh operators own their stage)")
         role = getattr(op, "fusion_role", None)
         if role is None:
             return (f"{op.name} has no composable device kernel "
-                    "(window/mesh/keyed-reduce operators own their stage)")
+                    "(mesh operators own their stage)")
+        if role == "window_terminator":
+            for o in self.ops:
+                if getattr(o, "state_init", None) is not None:
+                    # a window terminator's fused prefix runs TWICE per
+                    # batch (prep-time mask + in-program compose), so a
+                    # stateful prefix would double-advance its grid
+                    return (f"{op.name} (window terminator) needs a "
+                            f"stateless map/filter prefix — {o.name} "
+                            "carries per-key device state")
         routing = op.input_routing
         if routing is RoutingMode.KEYBY:
-            if self.first_op.input_routing is not RoutingMode.KEYBY:
+            entry_keyed = (self.first_op.input_routing
+                           is RoutingMode.KEYBY)
+            if entry_keyed:
+                if not _keys_compatible(self.first_op, op):
+                    return (f"{op.name} keys differ from the chain "
+                            "entry's — fusing would skip a real re-shard")
+            elif role in ("keyed_terminator", "window_terminator"):
+                # single-chip degeneration: with one replica the KEYBY
+                # shuffle routes every key to the same destination, so
+                # it reduces to the terminator's own in-program
+                # sort/segment — no host keyby-emitter hop needed
+                if self.parallelism != 1:
+                    return (f"{op.name} needs a cross-device KEYBY "
+                            f"shuffle (parallelism {self.parallelism}) — "
+                            "the re-shard owns its own stage boundary")
+            else:
                 return (f"{op.name} is keyed but the chain entry "
                         f"({self.first_op.name}) is not — the KEYBY "
                         "shuffle needs its own stage boundary")
-            if not _keys_compatible(self.first_op, op):
-                return (f"{op.name} keys differ from the chain entry's — "
-                        "fusing would skip a real re-shard")
         elif routing is not RoutingMode.FORWARD:
             return (f"{routing.name} input routing needs its own shuffle "
                     "stage")

@@ -96,6 +96,15 @@ class Ffat_Windows_TPU(TPUOperatorBase):
         self._prog_cache: Dict[Any, Any] = {}
         self._prog_lock = threading.Lock()
 
+    @property
+    def fusion_role(self) -> Optional[str]:
+        """``"window_terminator"``: the window op may END a fused device
+        chain (its step program absorbs a stateless map/filter prefix —
+        ``fused_ops.FusedFfatReplica``) but can never sit mid-chain: it
+        changes the row domain (tuples -> fired windows), so nothing can
+        compose after it inside one program."""
+        return "window_terminator"
+
     def build_replicas(self) -> None:
         self.replicas = [FfatTPUReplica(self, i)
                          for i in range(self.parallelism)]
@@ -229,6 +238,31 @@ class FfatTPUReplica(TPUReplicaBase):
         return self.__on_accel
 
     # ==================================================================
+    # fused-chain seams (overridden by fused_ops.FusedFfatReplica)
+    # ==================================================================
+    def _lift_fn(self) -> Callable:
+        """The lift entry every program traces. The fused-chain replica
+        overrides it to compose the chain's stateless map/filter prefix
+        IN FRONT of the user lift, so ``source -> map -> filter ->
+        Ffat_Windows`` runs as ONE program per batch."""
+        return self.op.lift
+
+    def _prefix_mask(self, batch: BatchTPU):
+        """Keep mask of a fused prefix filter over ``batch`` (None when
+        no prefix filters exist — the base replica never has any). MUST
+        be resolved at PREP time: the host control plane's liveness
+        quantities (max_leaf/next_fire/count) are exact, so a row the
+        prefix drops may never register a key, advance a leaf, or count
+        toward a CB window."""
+        return None
+
+    def _chain_tag(self):
+        """Cache-key discriminator for composed programs (the fused
+        replica returns the prefix signature; programs traced through a
+        different prefix must never collide)."""
+        return None
+
+    # ==================================================================
     # the per-batch device program
     # ==================================================================
     def _query_fns(self):
@@ -346,7 +380,7 @@ class FfatTPUReplica(TPUReplicaBase):
         host_seg = self._host_seg
         use_ktable = self._use_ktable()
 
-        lift = self.op.lift
+        lift = self._lift_fn()
         combine = self.op.combine
         F = self.F
         K_cap = self.K_cap
@@ -465,7 +499,13 @@ class FfatTPUReplica(TPUReplicaBase):
         # program outputs — including the warm-up no-op runs.
         # donate=False is for surfaces that re-execute on fixed example
         # args (the driver's __graft_entry__.entry()).
-        return jax.jit(step, donate_argnums=(6, 7) if donate else ())
+        # instrumented_jit: (re)traces land on Compile_count with the
+        # step signature, so the prewarm soak can assert compile-flat
+        # streams for window stages exactly like fused map chains
+        from ..monitoring.flightrec import instrumented_jit
+        return instrumented_jit(
+            step, self.stats, label=f"{self.stats.op_name}:step",
+            donate_argnums=(6, 7) if donate else ())
 
     def _make_fire_step(self):
         """Fire-only program: vmapped window queries + leaf eviction, no
@@ -515,7 +555,10 @@ class FfatTPUReplica(TPUReplicaBase):
             return tvalid, qr, qv, wid_out, key_out
 
         # tvalid donated (in-place eviction); trees is read-only here
-        return jax.jit(fire, donate_argnums=(1,))
+        from ..monitoring.flightrec import instrumented_jit
+        return instrumented_jit(fire, self.stats,
+                                label=f"{self.stats.op_name}:fire",
+                                donate_argnums=(1,))
 
     def _make_rebuild_step(self):
         """Standalone full-forest level rebuild: settles deferred
@@ -523,9 +566,10 @@ class FfatTPUReplica(TPUReplicaBase):
         program skips the rebuild by design and is only sound over a
         freshly rebuilt forest (see _make_fire_step). Shares the rebuild
         body (and the Pallas fast path) with the full program."""
-        import jax
-
-        return jax.jit(self._rebuild_fn(), donate_argnums=(0, 1))
+        from ..monitoring.flightrec import instrumented_jit
+        return instrumented_jit(self._rebuild_fn(), self.stats,
+                                label=f"{self.stats.op_name}:rebuild",
+                                donate_argnums=(0, 1))
 
     def _ensure_rebuilt(self) -> None:
         """Run the standalone rebuild iff ingest-only batches deferred
@@ -653,7 +697,7 @@ class FfatTPUReplica(TPUReplicaBase):
             return
         import jax
         import jax.numpy as jnp
-        shapes = jax.eval_shape(self.op.lift, sample_fields)
+        shapes = jax.eval_shape(self._lift_fn(), sample_fields)
         if not isinstance(shapes, dict):
             raise WindFlowError(f"{self.op.name}: lift must return a dict "
                                 "of columns")
@@ -676,10 +720,37 @@ class FfatTPUReplica(TPUReplicaBase):
         self._ensure_forest(batch.fields)
         if op.key_field is not None and op.key_field in batch.fields:
             self._key_dtype = np.dtype(batch.fields[op.key_field].dtype)
+        # fused prefix filter (FusedFfatReplica): rows it drops must not
+        # exist for the control plane AT ALL — no key registration, no
+        # max_leaf/next_fire advance, no CB count — exactly the rows the
+        # unfused topology's filter stage compacts away before the
+        # window operator ever sees them. Resolved here (prep time, one
+        # small D2H of the mask) because the liveness quantities are
+        # exact: deferring the mask to commit time would let phantom
+        # rows fire windows early and mis-index CB leaves.
+        keep = self._prefix_mask(batch)
+        rowsel = None
+        if keep is not None:
+            n_kept = int(keep.sum())
+            if n_kept < n:
+                self.stats.inputs_ignored += n - n_kept
+                if n_kept == 0:
+                    return None
+                rowsel = np.nonzero(keep)[0]
         keys, keys_arr = self.batch_keys_np(batch)
-        slots = self._slots_of(keys, keys_arr, n)
+        if rowsel is not None:
+            sub_arr = np.asarray(keys_arr)[rowsel]
+            keys = (sub_arr if isinstance(keys, np.ndarray)
+                    else [keys[i] for i in rowsel])
+            keys_arr = sub_arr
+            n_rows = len(rowsel)
+            ts_rows = batch.ts_host[:n][rowsel]
+        else:
+            n_rows = n
+            ts_rows = batch.ts_host[:n]
+        slots = self._slots_of(keys, keys_arr, n_rows)
         if op.win_type is WinType.TB:
-            leaves = batch.ts_host[:n] // op.pane_len
+            leaves = ts_rows // op.pane_len
         else:
             # CB: leaf = per-key arrival index (stable within the batch)
             from .keymap import group_positions
@@ -719,12 +790,13 @@ class FfatTPUReplica(TPUReplicaBase):
         nf = self.next_fire[slots]
         live = leaves >= nf
         n_live = int(live.sum())
-        n_late = n - n_live
+        n_late = n_rows - n_live
         if n_late:
             self.ignored += n_late
             self.stats.inputs_ignored += n_late
         if n_live:
-            if (n_late == 0 and n and int(leaves[0]) >= self._leaf_frontier
+            if (n_late == 0 and n_rows
+                    and int(leaves[0]) >= self._leaf_frontier
                     and bool((leaves[1:] >= leaves[:-1]).all())):
                 # monotone event time at or past every previously seen
                 # leaf (the common in-order source pattern): the last
@@ -763,7 +835,12 @@ class FfatTPUReplica(TPUReplicaBase):
         packed = slots * self.F + (leaves & (self.F - 1))  # F is pow-2
         if n_late:
             packed = np.where(live, packed, M)
-        comp_p[:n] = packed
+        if rowsel is None:
+            comp_p[:n] = packed
+        else:
+            # prefix-dropped rows keep the sentinel: the in-program
+            # segment plane treats them exactly like late/padding lanes
+            comp_p[rowsel] = packed
         if self._host_seg:
             big = cdt(M)
             order_p = np.argsort(comp_p, kind="stable").astype(np.int32)
@@ -993,6 +1070,62 @@ class FfatTPUReplica(TPUReplicaBase):
         if rb is not None:
             self.trees, self.tvalid = rb(self.trees, self.tvalid)
 
+    def _prewarm_schema(self):
+        """Schema of the batches this replica receives (the fused-chain
+        replica receives the CHAIN ENTRY's schema, not the window op's
+        declared one)."""
+        return self.op.schema
+
+    def prewarm(self, caps) -> Optional[int]:
+        """``PipeGraph.with_prewarm`` hook: compile every program
+        variant (full step at both fire tiers, ingest-only, fire-only,
+        standalone rebuild) per bucket capacity BEFORE the stream
+        starts, so ragged streams hopping between capacity buckets never
+        pay a mid-stream compile. Needs a declared schema — the forest
+        shape comes from ``eval_shape`` of the lift over schema-dtyped
+        zeros, and the key dtype from the schema's key column."""
+        sch = self._prewarm_schema()
+        if sch is None:
+            return None
+        from .ops_tpu import prewarm_zero_fields
+        kf = self.op.key_field
+        if kf is not None and kf in sch.fields:
+            self._key_dtype = np.dtype(sch.fields[kf])
+        warmed = 0
+        for cap in caps:
+            fields = prewarm_zero_fields(sch, cap)
+            self._ensure_forest(fields)
+            ckey, ikey = self._step_keys(cap)
+            if ckey in self._prog_cache and ikey in self._prog_cache:
+                continue
+            if self._host_seg:
+                seg = (None, None, None, None)  # _warm_programs builds
+                # its own host-seg no-op arrays per cap
+            else:
+                if self._seg_dummy is None:
+                    import jax
+                    self._seg_dummy = tuple(jax.device_put(a) for a in (
+                        np.zeros(1, dtype=np.int32),
+                        np.zeros(1, dtype=bool), np.zeros(1, dtype=bool),
+                        np.zeros(1, dtype=np.int32)))
+                seg = self._seg_dummy
+            self._warm_programs(cap, ckey, ikey, fields, *seg,
+                                self._ktable_arg())
+            warmed += 1
+        return warmed
+
+    def _step_keys(self, cap: int):
+        """(full-step, ingest-only) program cache keys for one capacity
+        bucket — the SINGLE definition shared by the per-batch path and
+        ``prewarm`` (a key drift between them would compile a program
+        nobody reuses and defeat the compile-flat guarantee). The chain
+        tag pins fused-prefix variants to their own cache rows."""
+        tag = self._chain_tag()
+        ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
+                self._use_ktable(), str(self._key_dtype), tag)
+        ikey = ("ingest", cap, self.K_cap, self.F, self._host_seg, tag)
+        return ckey, ikey
+
     def _prep_step(self, fields, wm, cap, comp_p,
                    order_p, same_p, end_p, flat_p, frontier):
         """Host half of the per-batch step: program warm-up, the ENTIRE
@@ -1009,9 +1142,7 @@ class FfatTPUReplica(TPUReplicaBase):
                     np.zeros(1, dtype=bool), np.zeros(1, dtype=np.int32)))
             order_p, same_p, end_p, flat_p = self._seg_dummy
         ktable = self._ktable_arg()
-        ckey = ("step", cap, self.K_cap, self.F, self._host_seg,
-                self._use_ktable(), str(self._key_dtype))
-        ikey = ("ingest", cap, self.K_cap, self.F, self._host_seg)
+        ckey, ikey = self._step_keys(cap)
         if ckey not in self._prog_cache or ikey not in self._prog_cache:
             # first batch of this capacity bucket: compile EVERY program
             # variant now (full both tiers, ingest-only, fire-only,

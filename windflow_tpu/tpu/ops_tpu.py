@@ -137,6 +137,78 @@ def op_batch_keys_np(op, batch: "BatchTPU"):
     return keys, np.asarray(keys)
 
 
+def op_batch_slots_np(op, batch: "BatchTPU"):
+    """Per-batch dense slot ids (HOST numpy) + slot->key order for
+    ``op``'s key fields. Device ops run in DEFAULT mode only, so
+    intra-batch output order is free: int keys take a vectorized unique
+    (slot order = sorted keys), others keep first-appearance order via
+    the Python loop. Module-level so the fused chain resolves slots with
+    the TERMINATOR's key fields, not the chain head's."""
+    keys = op_batch_keys(op, batch)
+    n = batch.size
+    keys_arr = np.asarray(keys)
+    # ndim guard: tuple-of-int keys become a 2-D int array
+    if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
+        uniq, inv = np.unique(keys_arr[:n], return_inverse=True)
+        slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
+        slots[:n] = inv
+        slot_of_key = {int(k): i for i, k in enumerate(uniq)}
+        return slots, slot_of_key
+    if n and keys_arr.ndim == 1 and keys_arr.dtype.kind == "V" \
+            and keys_arr.dtype.names:
+        # structured composite keys: one unique per batch, slot map
+        # keyed by plain tuples (shared dedup: keymap.py
+        # structured_unique; None = object field, fall to row loop)
+        from .keymap import structured_unique
+        uu = structured_unique(keys_arr, n)
+        if uu is None:
+            keys = keys_arr[:n].tolist()
+        else:
+            uniq, inv = uu
+            slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
+            slots[:n] = inv
+            slot_of_key = {k.item(): i for i, k in enumerate(uniq)}
+            return slots, slot_of_key
+    slot_of_key: Dict[Any, int] = {}
+    slots = np.zeros(batch.capacity, dtype=np.int32)
+    for i, k in enumerate(keys):
+        slots[i] = slot_of_key.setdefault(k, len(slot_of_key))
+    slots[n:] = len(slot_of_key)  # padding segment
+    return slots, slot_of_key
+
+
+def reduce_order_and_slots(op, batch: "BatchTPU"):
+    """(order, sorted slot ids, slot->key map) for a keyed reduce over
+    ``batch``, with ONE sort: int keys sort directly (group boundaries
+    give the sorted slot ids); other keys go through the generic slot
+    map + a radix argsort of the small dense ids. Shared by the
+    standalone ``ReduceTPUReplica`` and the fused chain's
+    ``keyed_terminator`` exit (both must group identically so their
+    per-slot outputs — and the slot->key emit order — stay exact
+    equals)."""
+    from .keymap import stable_group_argsort
+
+    n = batch.size
+    cap = batch.capacity
+    _, keys_arr = op_batch_keys_np(op, batch)
+    if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
+        order_n = np.argsort(keys_arr[:n], kind="stable")
+        sk = keys_arr[:n][order_n]
+        new_grp = np.r_[True, sk[1:] != sk[:-1]]
+        uniq = sk[new_grp]
+        slot_of_key = {int(k): i for i, k in enumerate(uniq)}
+        order = np.empty(cap, dtype=np.int32)
+        order[:n] = order_n
+        order[n:] = np.arange(n, cap)
+        ssorted = np.full(cap, len(uniq), dtype=np.int32)
+        ssorted[:n] = np.cumsum(new_grp) - 1
+        return order, ssorted, slot_of_key
+    slots_np, slot_of_key = op_batch_slots_np(op, batch)
+    order = stable_group_argsort(
+        slots_np, len(slot_of_key) + 1).astype(np.int32)
+    return order, slots_np[order], slot_of_key
+
+
 def _grid_scan_core(func, filter_mode: bool, M: int, KB: int):
     """The keyed grid-scan device core (see ``_KeyedStateScan``): rows
     scatter to a (KB x M) grid of (key slot, per-key position), a
@@ -412,41 +484,9 @@ class TPUReplicaBase(BasicReplica):
         return op_batch_keys(self.op, batch)
 
     def batch_slots_np(self, batch: BatchTPU):
-        """Per-batch dense slot ids (HOST numpy) + slot->key order. Device
-        ops run in DEFAULT mode only, so intra-batch output order is free:
-        int keys take a vectorized unique (slot order = sorted keys),
-        others keep first-appearance order via the Python loop."""
-        keys = self.batch_keys(batch)
-        n = batch.size
-        keys_arr = np.asarray(keys)
-        # ndim guard: tuple-of-int keys become a 2-D int array
-        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
-            uniq, inv = np.unique(keys_arr[:n], return_inverse=True)
-            slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
-            slots[:n] = inv
-            slot_of_key = {int(k): i for i, k in enumerate(uniq)}
-            return slots, slot_of_key
-        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind == "V" \
-                and keys_arr.dtype.names:
-            # structured composite keys: one unique per batch, slot map
-            # keyed by plain tuples (shared dedup: keymap.py
-            # structured_unique; None = object field, fall to row loop)
-            from .keymap import structured_unique
-            uu = structured_unique(keys_arr, n)
-            if uu is None:
-                keys = keys_arr[:n].tolist()
-            else:
-                uniq, inv = uu
-                slots = np.full(batch.capacity, len(uniq), dtype=np.int32)
-                slots[:n] = inv
-                slot_of_key = {k.item(): i for i, k in enumerate(uniq)}
-                return slots, slot_of_key
-        slot_of_key: Dict[Any, int] = {}
-        slots = np.zeros(batch.capacity, dtype=np.int32)
-        for i, k in enumerate(keys):
-            slots[i] = slot_of_key.setdefault(k, len(slot_of_key))
-        slots[n:] = len(slot_of_key)  # padding segment
-        return slots, slot_of_key
+        """See ``op_batch_slots_np`` (module-level: the fused chain
+        resolves slots with a sub-op's own key fields)."""
+        return op_batch_slots_np(self.op, batch)
 
 
 class TPUOperatorBase(BasicOperator):
@@ -909,10 +949,13 @@ class Reduce_TPU(TPUOperatorBase):
 
     @property
     def fusion_role(self) -> Optional[str]:
-        # the global fold changes cardinality (batch -> one tuple), so it
-        # can only END a fused chain; keyed reduce owns a KEYBY shuffle
-        # stage and never fuses
-        return "terminator" if self.key_extractor is None else None
+        # both variants change cardinality, so both may only END a fused
+        # chain. The keyed reduce's KEYBY shuffle degenerates to an
+        # in-program sort/segment when no cross-device re-shard exists
+        # (single replica, or a key-compatible keyed entry) — the
+        # legality check in topology/stage.py gates exactly that
+        return ("terminator" if self.key_extractor is None
+                else "keyed_terminator")
 
     def build_replicas(self) -> None:
         cls = (ReduceTPUReplica if self.key_extractor is not None
@@ -1009,31 +1052,9 @@ class ReduceTPUReplica(TPUReplicaBase):
         return len(caps)
 
     def _order_and_slots(self, batch: BatchTPU):
-        """(order, sorted slot ids, slot->key map) with ONE sort: int
-        keys sort directly (group boundaries give the sorted slot ids);
-        other keys go through the generic slot map + a radix argsort of
-        the small dense ids."""
-        from .keymap import stable_group_argsort
-
-        n = batch.size
-        cap = batch.capacity
-        _, keys_arr = self.batch_keys_np(batch)
-        if n and keys_arr.ndim == 1 and keys_arr.dtype.kind in "iu":
-            order_n = np.argsort(keys_arr[:n], kind="stable")
-            sk = keys_arr[:n][order_n]
-            new_grp = np.r_[True, sk[1:] != sk[:-1]]
-            uniq = sk[new_grp]
-            slot_of_key = {int(k): i for i, k in enumerate(uniq)}
-            order = np.empty(cap, dtype=np.int32)
-            order[:n] = order_n
-            order[n:] = np.arange(n, cap)
-            ssorted = np.full(cap, len(uniq), dtype=np.int32)
-            ssorted[:n] = np.cumsum(new_grp) - 1
-            return order, ssorted, slot_of_key
-        slots_np, slot_of_key = self.batch_slots_np(batch)
-        order = stable_group_argsort(
-            slots_np, len(slot_of_key) + 1).astype(np.int32)
-        return order, slots_np[order], slot_of_key
+        """See ``reduce_order_and_slots`` (module-level: shared with the
+        fused chain's keyed-terminator exit)."""
+        return reduce_order_and_slots(self.op, batch)
 
     def prep_device_batch(self, batch: BatchTPU) -> Optional[Callable]:
         import jax
