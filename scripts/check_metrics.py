@@ -95,6 +95,12 @@ REQUIRED_FAMILIES = (
     "windflow_megabatch_batches_per_loop_avg",
     "windflow_megabatch_max",
     "windflow_programs_per_batch",
+    # columnar ingest plane (a third graph runs a Columnar_Source so
+    # the block counters carry real samples; row-only replicas export
+    # them as 0)
+    "windflow_ingest_blocks_total",
+    "windflow_ingest_rows_per_block_avg",
+    "windflow_ingest_block_ns_per_row",
 )
 
 _SAMPLE_RE = re.compile(
@@ -214,6 +220,38 @@ def run_mesh_graph():
     assert seen[0] == 2_000, f"mesh sink saw {seen[0]} tuples"
 
 
+def run_columnar_graph():
+    """A third tiny graph over the columnar ingest plane: block source
+    -> device map -> sink, so the ``windflow_ingest_*`` families carry
+    non-zero samples (row-only replicas export them as 0)."""
+    import numpy as np
+
+    from windflow_tpu import (ArrayBlockSource, Columnar_Source_Builder,
+                              ExecutionMode, PipeGraph, Sink_Builder,
+                              TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n = 4_000
+    blocks = ArrayBlockSource({"v": np.arange(n, dtype=np.int64)},
+                              block_size=512)
+    seen = [0]
+    g = PipeGraph("check_metrics_columnar", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.add_source(Columnar_Source_Builder(blocks).with_name("csrc")
+                 .with_output_batch_size(256).build()) \
+        .add(Map_TPU_Builder(lambda f: {"v": f["v"] * 2})
+             .with_name("cmap").build()) \
+        .add_sink(Sink_Builder(
+            lambda t: seen.__setitem__(0, seen[0] + 1) if t else None)
+            .with_name("cout").build())
+    g.run()
+    assert seen[0] == n, f"columnar sink saw {seen[0]} tuples"
+    src_reps = [o for o in g.get_stats()["Operators"]
+                if o["name"] == "csrc"][0]["replicas"]
+    assert sum(r["Ingest_blocks"] for r in src_reps) > 0, \
+        "columnar source reported no ingest blocks"
+
+
 def run_graph_and_scrape():
     """Run the tiny graph against a fresh server; return (metrics text,
     /trace document, pre-run /metrics status code)."""
@@ -303,6 +341,9 @@ def run_graph_and_scrape():
         # the mesh-plane leg: a second graph over the virtual mesh so the
         # windflow_mesh_* families carry real samples
         run_mesh_graph()
+        # the columnar-ingest leg: a block source feeds the device map
+        # so the windflow_ingest_* families carry non-zero samples
+        run_columnar_graph()
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land
         import time
@@ -310,7 +351,8 @@ def run_graph_and_scrape():
         while time.monotonic() < deadline:
             reports = server.snapshot()["reports"]
             if "check_metrics" in reports \
-                    and "check_metrics_mesh" in reports:
+                    and "check_metrics_mesh" in reports \
+                    and "check_metrics_columnar" in reports:
                 break
             time.sleep(0.05)
         else:

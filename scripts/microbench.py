@@ -1048,6 +1048,99 @@ def bench_overload() -> None:
                               "for bounded latency by design"}))
 
 
+def bench_ingest() -> None:
+    """--ingest: the columnar ingest plane (Columnar_Source +
+    TPUStageEmitter.append_columns) vs the per-tuple row path on the
+    ingest-bound config — source -> stateless device map -> sink at
+    output batch 4096, where host batch construction dominates.
+    Interleaved best-of-6 (the bench.py A/B lesson: back-to-back
+    same-config passes fold host drift into the delta): one row leg,
+    three block legs (block sizes 1024/4096/16384). Reports tuples/s
+    per leg, the block-vs-row speedup (acceptance gate: >= 3x at block
+    4096), the flight-recorder ``host_prep`` share of wall time per leg
+    (batch construction: rows->columns encode+pad+device_put on the row
+    path, key-concat+device_put on the block path), and the source's
+    own Ingest_* counters from the block legs."""
+    from windflow_tpu import (ArrayBlockSource, Columnar_Source_Builder,
+                              ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    N, B, REPS = 400_000, 4096, 6
+    BLOCK_SIZES = (1024, 4096, 16384)
+    vals = np.arange(N, dtype=np.int64)
+    keys = (vals * 2654435761 % 97).astype(np.int64)
+
+    def one_pass(block_size):
+        if block_size:
+            blocks = ArrayBlockSource({"k": keys, "v": vals},
+                                      block_size=block_size)
+            sb = Columnar_Source_Builder(blocks)
+        else:
+            def src(shipper):
+                for i in range(N):
+                    shipper.push({"k": int(keys[i]), "v": int(vals[i])})
+            sb = Source_Builder(src)
+        seen = [0]
+        g = PipeGraph("mb_ingest", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_flight_recorder(events=65536)
+        # columnar sink: the exit side must not re-introduce per-tuple
+        # Python, or the measurement caps at the decode rate and the
+        # config stops being ingest-bound
+        g.add_source(sb.with_name("src").with_output_batch_size(B)
+                     .build()) \
+         .add(Map_TPU_Builder(lambda f: {"k": f["k"],
+                                         "v": f["v"] * 2 + 1})
+              .with_name("map").build()) \
+         .add_sink(Sink_Builder(
+             lambda cols, ts: seen.__setitem__(0, seen[0] + len(ts))
+             if ts is not None else None)
+             .with_columns().with_name("snk").build())
+        t0 = time.perf_counter()
+        g.run()
+        wall_us = (time.perf_counter() - t0) * 1e6
+        assert seen[0] == N, f"sink saw {seen[0]} of {N}"
+        preps = [e[2] for rec in g._recorders
+                 for e in rec.snapshot() if e[1] == "host_prep"]
+        prep_us = sum(preps)
+        src_rep = [o for o in g.get_stats()["Operators"]
+                   if o["name"] == "src"][0]["replicas"][0]
+        return (N / (wall_us / 1e6), prep_us / wall_us,
+                prep_us / max(1, len(preps)), src_rep)
+
+    legs = [("row", 0)] + [(f"block{bs}", bs) for bs in BLOCK_SIZES]
+    best = {label: (0.0, 0.0, 0.0, None) for label, _ in legs}
+    for _ in range(REPS):
+        for label, bs in legs:
+            tps, prep_share, prep_per_batch, src_rep = one_pass(bs)
+            if tps > best[label][0]:
+                best[label] = (tps, prep_share, prep_per_batch, src_rep)
+
+    for label, _ in legs:
+        report(f"ingest_{label}_tuples_per_sec", best[label][0])
+    for label, _ in legs:
+        # per-batch cost is the directional number (the share of wall
+        # RISES on the block legs because the wall collapses around it)
+        print(json.dumps({"bench": f"ingest_host_prep_{label}",
+                          "us_per_batch": round(best[label][2], 1),
+                          "share_of_wall": round(best[label][1], 4)}))
+    base = best["row"][0]
+    for bs in BLOCK_SIZES:
+        ratio = best[f"block{bs}"][0] / base if base else 0.0
+        print(json.dumps({"bench": f"ingest_block{bs}_vs_row",
+                          "value": round(ratio, 3), "unit": "speedup",
+                          "acceptance": ">=3x at block 4096"
+                          if bs == 4096 else None}))
+    r = best["block4096"][3]
+    print(json.dumps({"bench": "ingest_source_counters_block4096",
+                      "Ingest_blocks": r["Ingest_blocks"],
+                      "Ingest_rows_per_block_avg":
+                          r["Ingest_rows_per_block_avg"],
+                      "Ingest_block_ns_per_row":
+                          r["Ingest_block_ns_per_row"]}))
+
+
 def bench_restart() -> None:
     """--restart: cold-vs-warm restart-to-first-tuple time with the JAX
     persistent compilation cache (WF_COMPILE_CACHE_DIR /
@@ -1259,6 +1352,9 @@ def main() -> None:
         return
     if "--overload" in sys.argv[1:]:
         bench_overload()
+        return
+    if "--ingest" in sys.argv[1:]:
+        bench_ingest()
         return
     bench_staging()
     bench_reshard()

@@ -1,0 +1,443 @@
+"""Zero-copy columnar ingest plane (Columnar_Source -> block staging).
+
+Differentials: the block path must be byte-identical to the row path at
+the sink — same values in the same order on FORWARD edges, same per-key
+order and sums across KEYBY splits. Partial blocks flush on EOS, the
+admission gate sheds block suffixes with exact accounting
+(offered == admitted + shed), the block-granular cursor replays
+exactly-once through a supervised mid-stream crash, and the Kafka block
+adapter keeps the per-partition offset semantics of the per-message
+loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (ArrayBlockSource, Columnar_Source,
+                          Columnar_Source_Builder, ExecutionMode,
+                          Keyed_Windows, PipeGraph, Sink_Builder,
+                          Source_Builder, TimePolicy, WindFlowError,
+                          WinType)
+from windflow_tpu.overload.admission import AdmissionGate
+from windflow_tpu.supervision import RestartPolicy
+from windflow_tpu.tpu import Map_TPU_Builder
+
+N = 4000
+RNG = np.random.default_rng(7)
+VALS = RNG.integers(-1_000_000, 1_000_000, N).astype(np.int64)
+KEYS = RNG.integers(0, 13, N).astype(np.int64)
+
+
+class ColumnCollector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls = []
+
+    def sink(self, cols, ts):
+        if cols is None:
+            return
+        with self._lock:
+            self.calls.append({k: np.array(v) for k, v in cols.items()})
+
+    def col(self, name):
+        return (np.concatenate([c[name] for c in self.calls])
+                if self.calls else np.array([], dtype=np.int64))
+
+
+def _run(source_builder, keyed=False, batch=256):
+    coll = ColumnCollector()
+    g = PipeGraph("col_ingest", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    m = Map_TPU_Builder(lambda f: {"key": f["key"], "v": f["v"] * 3 + 1})
+    if keyed:
+        m = m.with_key_by("key").with_parallelism(2)
+    g.add_source(source_builder.with_name("src")
+                 .with_output_batch_size(batch).build()) \
+        .add(m.build()) \
+        .add_sink(Sink_Builder(coll.sink).with_columns().build())
+    g.run()
+    src_rep = [o for o in g.get_stats()["Operators"]
+               if o["name"] == "src"][0]["replicas"][0]
+    return coll, src_rep
+
+
+def _row_source():
+    def src(shipper):
+        for k, v in zip(KEYS, VALS):
+            shipper.push({"key": int(k), "v": int(v)})
+    return Source_Builder(src)
+
+
+def _block_source(block_size=300):
+    return Columnar_Source_Builder(
+        ArrayBlockSource({"key": KEYS, "v": VALS}, block_size=block_size))
+
+
+# ---------------------------------------------------------------------------
+# row-vs-block differentials
+# ---------------------------------------------------------------------------
+def test_forward_differential_byte_identical():
+    """FORWARD par=1: exact value sequence at the sink, row vs block,
+    with a block size that divides into neither the stream nor the
+    staging batch (re-batching must be seam-free)."""
+    row, _ = _run(_row_source())
+    blk, src_rep = _run(_block_source(block_size=300))
+    assert np.array_equal(row.col("v"), blk.col("v"))
+    assert np.array_equal(row.col("key"), blk.col("key"))
+    assert src_rep["Ingest_blocks"] > 0  # fast path actually taken
+    assert src_rep["Ingest_rows_per_block_avg"] > 0
+
+
+def test_keyby_differential_per_key_order_and_sums():
+    """KEYBY par=2: the vectorized split (hash once, argsort/bincount)
+    must keep per-key order and totals identical to the row path.
+    Cross-key interleave at the sink is scheduling, so compare per-key
+    sequences, not the flat list."""
+    row, _ = _run(_row_source(), keyed=True)
+    blk, src_rep = _run(_block_source(block_size=300), keyed=True)
+    assert src_rep["Ingest_blocks"] > 0
+
+    def per_key(coll):
+        keys, vs = coll.col("key"), coll.col("v")
+        return {int(k): vs[keys == k] for k in np.unique(keys)}
+
+    a, b = per_key(row), per_key(blk)
+    assert set(a) == set(b) == set(int(k) for k in np.unique(KEYS))
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"per-key order diverged at {k}"
+
+
+def test_partial_block_flush_on_eos():
+    """Stream length not a multiple of block or batch size: the staged
+    remainder must flush at EOS, nothing truncated, nothing padded in."""
+    n = 1000  # 1000 = 512 + 488; batch 384 leaves a 232-row tail
+    vals = np.arange(n, dtype=np.int64)
+    coll = ColumnCollector()
+    g = PipeGraph("partial", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Columnar_Source_Builder(
+        ArrayBlockSource({"key": vals % 3, "v": vals}, block_size=512))
+        .with_output_batch_size(384).build()) \
+        .add(Map_TPU_Builder(lambda f: {"v": f["v"] + 1}).build()) \
+        .add_sink(Sink_Builder(coll.sink).with_columns().build())
+    g.run()
+    assert np.array_equal(coll.col("v"), vals + 1)
+
+
+# ---------------------------------------------------------------------------
+# block re-chunking, schema, env knob
+# ---------------------------------------------------------------------------
+def test_with_block_size_rechunks_oversized_yields():
+    vals = np.arange(1000, dtype=np.int64)
+
+    def func():
+        yield {"v": vals}  # one oversized block
+
+    coll = ColumnCollector()
+    g = PipeGraph("rechunk", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Columnar_Source_Builder(func).with_name("src")
+                 .with_block_size(256).with_output_batch_size(256).build()) \
+        .add(Map_TPU_Builder(lambda f: {"v": f["v"]}).build()) \
+        .add_sink(Sink_Builder(coll.sink).with_columns().build())
+    g.run()
+    assert np.array_equal(coll.col("v"), vals)
+    src_rep = [o for o in g.get_stats()["Operators"]
+               if o["name"] == "src"][0]["replicas"][0]
+    assert src_rep["Ingest_blocks"] == 4  # 256+256+256+232
+
+    with pytest.raises(WindFlowError, match="block size"):
+        Columnar_Source_Builder(func).with_block_size(0)
+
+
+def test_block_size_env_default(monkeypatch):
+    monkeypatch.setenv("WF_INGEST_BLOCK_ROWS", "128")
+    op = Columnar_Source(lambda: iter(()))
+    assert op.block_size == 128
+
+
+def test_schema_canonicalizes_dtype_at_edge():
+    coll = ColumnCollector()
+    g = PipeGraph("schema", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+
+    def func():
+        yield {"v": np.arange(64, dtype=np.float64)}  # wrong dtype
+
+    g.add_source(Columnar_Source_Builder(func)
+                 .with_schema({"v": np.int32})
+                 .with_output_batch_size(64).build()) \
+        .add(Map_TPU_Builder(lambda f: {"v": f["v"] * 2}).build()) \
+        .add_sink(Sink_Builder(coll.sink).with_columns().build())
+    g.run()
+    got = coll.col("v")
+    assert got.dtype in (np.int32, np.int64)  # canonicalized, not float
+    assert np.array_equal(np.sort(got), np.arange(64) * 2)
+
+
+# ---------------------------------------------------------------------------
+# admission gate on block boundaries: exact accounting
+# ---------------------------------------------------------------------------
+class _RecordingEmitter:
+    def __init__(self):
+        self.rows = []
+        self.batches = []   # (cols, ts_arr, wm, trace_rows)
+        self.trace_ts = 0
+
+    def emit(self, payload, ts, wm):
+        self.rows.append((payload, ts, wm))
+
+    def emit_columns(self, cols, ts_arr, wm, trace_rows=None):
+        self.batches.append((cols, ts_arr, wm, trace_rows))
+
+
+def _replica():
+    from windflow_tpu.operators.source import Source
+
+    op = Source(lambda s: None, name="s")
+    op.build_replicas()
+    r = op.replicas[0]
+    r.emitter = _RecordingEmitter()
+    return r
+
+
+def test_gate_sheds_block_suffix_exact_accounting():
+    """offered == admitted + shed on a block push: the admitted prefix
+    ships (exact values), the suffix sheds in one accounting step."""
+    r = _replica()
+    gate = AdmissionGate(r, "drop_newest", 1000.0)
+    gate.bucket._tokens = 40.0
+    r._gate = gate
+    cols = {"v": np.arange(100, dtype=np.int64)}
+    r.ship_columns(cols, np.arange(100, dtype=np.int64), 5)
+    st = r.stats
+    assert st.inputs_received == 40
+    assert st.shed_records == 60
+    assert st.inputs_received + st.shed_records == 100  # offered
+    (got, ts, wm, _), = r.emitter.batches
+    assert np.array_equal(got["v"], np.arange(40))
+    assert wm == 5
+    # tokens return: the next block admits fully, accounting still exact
+    gate.bucket._tokens = 1000.0
+    r.ship_columns({"v": np.arange(100, 150, dtype=np.int64)},
+                   np.arange(50, dtype=np.int64), 9)
+    assert st.inputs_received == 90 and st.shed_records == 60
+    assert np.array_equal(r.emitter.batches[-1][0]["v"],
+                          np.arange(100, 150))
+
+
+def test_gate_zero_grant_sheds_whole_block():
+    r = _replica()
+    gate = AdmissionGate(r, "drop_newest", 1000.0)
+    gate.bucket._tokens = 0.0
+    gate.bucket.rate = 0.0
+    gate.bucket.burst = 0.0
+    r._gate = gate
+    r.ship_columns({"v": np.arange(8)}, np.arange(8, dtype=np.int64), 1)
+    assert r.emitter.batches == []
+    assert r.stats.inputs_received == 0 and r.stats.shed_records == 8
+
+
+# ---------------------------------------------------------------------------
+# vectorized trace cohort: block path traces exactly the row-path rows
+# ---------------------------------------------------------------------------
+def test_trace_cohort_matches_row_path_positions():
+    """sample_every=4 traces global positions 4, 8, 12, ... on the row
+    path (mask gate). The block path must pick the same cohort as one
+    arange per block, continuous across block boundaries."""
+    r = _replica()
+    r.stats.sample_every = 4
+    r._trace_mask = 3
+    r.ship_columns({"v": np.arange(10)}, np.arange(10, dtype=np.int64), 0)
+    r.ship_columns({"v": np.arange(10)}, np.arange(10, dtype=np.int64), 0)
+    (_, _, _, tr1), (_, _, _, tr2) = r.emitter.batches
+    # block 1 covers positions 1..10 -> traced 4, 8 -> offsets 3, 7
+    assert np.array_equal(tr1, [3, 7])
+    # block 2 covers positions 11..20 -> traced 12, 16, 20 -> 1, 5, 9
+    assert np.array_equal(tr2, [1, 5, 9])
+    assert r.emitter.trace_ts > 0
+
+    # sampling off: no cohort, no stamp
+    r2 = _replica()
+    assert r2.stats.sample_every == 0
+    r2.ship_columns({"v": np.arange(10)}, np.arange(10, dtype=np.int64), 0)
+    assert r2.emitter.batches[0][3] is None
+    assert r2.emitter.trace_ts == 0
+
+
+# ---------------------------------------------------------------------------
+# supervised crash mid-stream: block cursor + exactly-once
+# ---------------------------------------------------------------------------
+class CrashingBlockSource(ArrayBlockSource):
+    """Raises once after ``crash_after`` blocks have been yielded
+    (cumulative across restarts, so the replay passes the crash
+    point)."""
+
+    def __init__(self, cols, block_size, crash_after=None):
+        super().__init__(cols, block_size=block_size)
+        self.crash_after = crash_after
+        self.blocks_out = 0
+
+    def __call__(self):
+        for block in super().__call__():
+            yield block
+            self.blocks_out += 1
+            if self.crash_after is not None \
+                    and self.blocks_out == self.crash_after:
+                self.crash_after = None
+                raise ValueError("synthetic mid-stream block crash")
+
+
+def _windows_graph(tmp, src_func, results, supervised):
+    g = PipeGraph("col_sup", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(interval=0.05, store_dir=str(tmp / "store"))
+    if supervised:
+        g.with_supervision(RestartPolicy(max_restarts=4, backoff_s=0.02,
+                                         backoff_max_s=0.1))
+    win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                        key_extractor=lambda t: int(t["k"]), win_len=4,
+                        slide_len=4, win_type=WinType.CB, name="kw",
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            results.append((t.key, t.wid, t.value))
+
+    g.add_source(Columnar_Source_Builder(src_func).with_name("src").build()) \
+        .add(win) \
+        .add_sink(Sink_Builder(sink).with_name("snk")
+                  .with_exactly_once(staging_dir=str(tmp / "txn")).build())
+    return g
+
+
+@pytest.mark.slow
+def test_supervised_crash_mid_stream_exactly_once(tmp_path):
+    """A block source crashing mid-stream under supervision: the
+    block-granular cursor replays from the checkpoint and the
+    exactly-once sink output matches a crash-free run exactly."""
+    import time as _time
+
+    n = 2000
+    cols = {"k": (np.arange(n) % 7).astype(np.int64),
+            "v": np.arange(n, dtype=np.int64)}
+
+    golden = []
+    _windows_graph(tmp_path / "gold",
+                   ArrayBlockSource(cols, block_size=50),
+                   golden, supervised=False).run()
+    assert golden
+
+    class Slowed(CrashingBlockSource):
+        # a few ms per block so interval checkpoints land pre-crash
+        def __call__(self):
+            for block in super().__call__():
+                _time.sleep(0.004)
+                yield block
+
+    results = []
+    g = _windows_graph(tmp_path / "run",
+                       Slowed(cols, block_size=50, crash_after=25),
+                       results, supervised=True)
+    g.run()
+    assert sorted(results) == sorted(golden)
+    st = g.get_stats()
+    assert st["Supervision"]["Supervision_restarts"] == 1
+    src_op = next(o for o in st["Operators"] if o["name"] == "src")
+    assert "ValueError" in src_op["replicas"][0]["Worker_last_error"]
+
+
+# ---------------------------------------------------------------------------
+# Kafka block adapter (memory broker)
+# ---------------------------------------------------------------------------
+def test_kafka_columnar_blocks_consumes_all():
+    from windflow_tpu.kafka import Kafka_Source_Builder, MemoryBroker
+
+    MemoryBroker.reset()
+    try:
+        b = MemoryBroker.get("cb1", 4)
+        n = 300
+        for i in range(n):
+            b.produce("events", {"k": i % 5, "v": i + 1}, key=i % 5)
+
+        total = [0, 0]
+
+        def deser(msgs, shipper):
+            if msgs is None:
+                return False  # idle: drained
+            vs = np.array([m.payload["v"] for m in msgs], dtype=np.int64)
+            ks = np.array([m.payload["k"] for m in msgs], dtype=np.int64)
+            shipper.push_columns({"k": ks, "v": vs})
+            return True
+
+        def sink(t):
+            if t is not None:
+                total[0] += int(t["v"])
+                total[1] += 1
+
+        g = PipeGraph("kblk")
+        src = (Kafka_Source_Builder(deser).with_brokers("memory://cb1")
+               .with_topics("events").with_group_id("g1")
+               .with_columnar_blocks(64).with_idleness(50).build())
+        g.add_source(src).add_sink(Sink_Builder(sink).build())
+        g.run()
+        assert total[1] == n
+        assert total[0] == sum(range(1, n + 1))
+    finally:
+        MemoryBroker.reset()
+
+
+def test_kafka_consume_batch_advances_offsets_like_per_message():
+    """consume_batch must move the same per-partition cursors that
+    snapshot_positions / commit read — batch polling cannot change the
+    checkpoint story."""
+    from windflow_tpu.kafka.connectors import MemoryBroker, MemoryTransport
+
+    MemoryBroker.reset()
+    try:
+        b = MemoryBroker.get("cb2", 2)
+        for i in range(10):
+            b.produce("t", {"v": i}, partition=i % 2)
+        tr = MemoryTransport("cb2")
+        tr.subscribe(["t"], "g", 0, 1, {})
+        got = []
+        while True:
+            msgs = tr.consume_batch(4)
+            if not msgs:
+                break
+            got.extend(m.payload["v"] for m in msgs)
+        assert sorted(got) == list(range(10))
+        assert tr.snapshot_positions() == {("t", 0): 5, ("t", 1): 5}
+        # explicit start offsets replay the suffix, batch mode included
+        tr2 = MemoryTransport("cb2")
+        tr2.subscribe(["t"], "g2", 0, 1, {("t", 0): 3, ("t", 1): 3})
+        replay = []
+        while True:
+            msgs = tr2.consume_batch(8)
+            if not msgs:
+                break
+            replay.extend(m.payload["v"] for m in msgs)
+        assert len(replay) == 4
+    finally:
+        MemoryBroker.reset()
+
+
+def test_with_columnar_blocks_validation():
+    from windflow_tpu.kafka import Kafka_Source_Builder
+
+    with pytest.raises(WindFlowError, match="block_size"):
+        Kafka_Source_Builder(lambda m, s: False).with_columnar_blocks(0)
+
+
+# ---------------------------------------------------------------------------
+# functor contract errors
+# ---------------------------------------------------------------------------
+def test_columnar_functor_bad_yield_raises():
+    def func():
+        yield [1, 2, 3]  # not a cols dict / tuple
+
+    g = PipeGraph("bad", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.add_source(Columnar_Source_Builder(func).build()) \
+        .add_sink(Sink_Builder(lambda t: None).build())
+    with pytest.raises(WindFlowError, match="yield"):
+        g.run()

@@ -179,7 +179,7 @@ class _RecordingEmitter:
     def emit(self, payload, ts, wm):
         self.rows.append((payload, ts, wm))
 
-    def emit_columns(self, cols, ts_arr, wm):
+    def emit_columns(self, cols, ts_arr, wm, trace_rows=None):
         self.batches.append((cols, ts_arr, wm))
 
 

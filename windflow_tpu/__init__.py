@@ -24,8 +24,8 @@ shim), ``windflow_tpu.persistent`` (out-of-core keyed state),
 
 from .basic import (ExecutionMode, JoinMode, RoutingMode, TimePolicy,
                     WindFlowError, WinType)
-from .builders import (Ffat_Windows_Builder, Filter_Builder,
-                       Interval_Join_Builder,
+from .builders import (Columnar_Source_Builder, Ffat_Windows_Builder,
+                       Filter_Builder, Interval_Join_Builder,
                        FlatMap_Builder, Keyed_Windows_Builder, Map_Builder,
                        MapReduce_Windows_Builder, Paned_Windows_Builder,
                        Parallel_Windows_Builder, Reduce_Builder, Sink_Builder,
@@ -39,7 +39,8 @@ from .operators.flatfat import FlatFAT
 from .operators.window_engine import WinResult
 from .operators.windows import (Keyed_Windows, MapReduce_Windows,
                                 Paned_Windows, Parallel_Windows)
-from .operators.source import Source, SourceShipper
+from .operators.source import (ArrayBlockSource, Columnar_Source, Source,
+                               SourceShipper, arrow_block_source)
 from .overload import GovernorPolicy, ShedLog, TokenBucket
 from .scaling.autoscaler import AutoscalePolicy
 from .sinks.transactional import FencedWriteError
@@ -54,11 +55,13 @@ __all__ = [
     "ExecutionMode", "TimePolicy", "WinType", "RoutingMode", "JoinMode",
     "WindFlowError", "FencedWriteError",
     "PipeGraph", "MultiPipe",
-    "Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
+    "Source", "Columnar_Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
     "SourceShipper", "Shipper",
+    "ArrayBlockSource", "arrow_block_source",
     "RuntimeContext", "LocalStorage",
     "Single", "Batch",
-    "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
+    "Source_Builder", "Columnar_Source_Builder",
+    "Map_Builder", "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder",
     "Keyed_Windows", "Parallel_Windows", "Paned_Windows",
     "MapReduce_Windows", "Ffat_Windows", "FlatFAT", "WinResult",

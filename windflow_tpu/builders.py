@@ -21,7 +21,7 @@ from typing import Any, Callable, Optional
 
 from .basic import RoutingMode, WindFlowError
 from .operators.basic_ops import Filter, FlatMap, Map, Reduce, Sink
-from .operators.source import Source
+from .operators.source import Columnar_Source, Source
 
 
 class BasicBuilder:
@@ -189,6 +189,39 @@ class Source_Builder(_SourceOverloadMixin, BasicBuilder):
         return self._finish_overload(self._finish(
             Source(self._func, self._name, self._parallelism,
                    self._output_batch_size)))
+
+
+class Columnar_Source_Builder(_SourceOverloadMixin, BasicBuilder):
+    """Builder for schema-declared BLOCK sources: the functor yields
+    ``(cols, ts)`` column blocks instead of pushing per-tuple (see
+    ``Columnar_Source``). ``with_block_size`` re-chunks oversized yields;
+    ``with_schema`` declares column dtypes canonicalized at the edge."""
+
+    _default_name = "columnar_source"
+
+    def __init__(self, func: Callable) -> None:
+        super().__init__(func)
+        self._block_size = 0
+        self._block_schema: Optional[dict] = None
+
+    def with_block_size(self, n: int) -> "Columnar_Source_Builder":
+        if n <= 0:
+            raise WindFlowError("with_block_size: block size must be >= 1")
+        self._block_size = int(n)
+        return self
+
+    def with_schema(self, schema: dict) -> "Columnar_Source_Builder":
+        if not isinstance(schema, dict) or not schema:
+            raise WindFlowError(
+                "with_schema: expected a non-empty {field: dtype} dict")
+        self._block_schema = dict(schema)
+        return self
+
+    def build(self) -> Columnar_Source:
+        return self._finish_overload(self._finish(
+            Columnar_Source(self._func, self._name, self._parallelism,
+                            self._output_batch_size, self._block_size,
+                            self._block_schema)))
 
 
 class Map_Builder(_RoutableBuilder):

@@ -20,6 +20,7 @@ class Kafka_Source_Builder(_SourceOverloadMixin, BasicBuilder):
         self._group_id = "windflow"
         self._offsets: Dict[Tuple[str, int], int] = {}
         self._idleness_ms = 100
+        self._block_size: Optional[int] = None  # with_columnar_blocks
 
     def with_brokers(self, brokers: str):
         self._brokers = brokers
@@ -43,15 +44,33 @@ class Kafka_Source_Builder(_SourceOverloadMixin, BasicBuilder):
         self._idleness_ms = ms
         return self
 
+    def with_columnar_blocks(self, block_size: int = 512):
+        """Columnar block mode: the deserialization functor receives a
+        non-empty LIST of KafkaMessages (one batch poll, up to
+        ``block_size``) instead of single messages, decodes them
+        vectorized and calls ``shipper.push_columns`` — no per-tuple
+        Python on the ingest path. ``None`` (idle timeout) and the
+        ``False`` stop flag keep their meaning; per-partition offset
+        snapshots and barrier placement are unchanged."""
+        if block_size <= 0:
+            raise WindFlowError(
+                "with_columnar_blocks: block_size must be positive")
+        self._block_size = block_size
+        return self
+
     def build(self) -> Kafka_Source:
         if not self._brokers:
             raise WindFlowError("Kafka_Source_Builder: withBrokers mandatory")
         if not self._topics:
             raise WindFlowError("Kafka_Source_Builder: withTopics mandatory")
-        return self._finish_overload(self._finish(Kafka_Source(
+        op = self._finish_overload(self._finish(Kafka_Source(
             self._func, self._brokers, self._topics, self._group_id,
             self._offsets, self._idleness_ms, self._name, self._parallelism,
             self._output_batch_size)))
+        if self._block_size is not None:
+            op.block_mode = True
+            op.block_size = self._block_size
+        return op
 
 
 class Kafka_Sink_Builder(BasicBuilder):

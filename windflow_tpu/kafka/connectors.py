@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..basic import OpType, RoutingMode, WindFlowError, current_time_usecs
 from ..operators.base import BasicOperator, BasicReplica, arity
 from ..operators.source import SourceShipper
@@ -175,6 +177,15 @@ class MemoryBroker:
                 return part[offset]
         return None
 
+    def poll_run(self, topic: str, partition: int, offset: int,
+                 max_n: int) -> List[KafkaMessage]:
+        """Contiguous run from one partition — the batch-poll primitive
+        the columnar block adapter rides (one lock round per partition
+        instead of one per message)."""
+        t = self._topic(topic)
+        with self._lock:
+            return t[partition][offset:offset + max_n]
+
     def end_offset(self, topic: str, partition: int) -> int:
         t = self._topic(topic)
         with self._lock:
@@ -312,6 +323,25 @@ class MemoryTransport:
                 return msg
         return None
 
+    def consume_batch(self, max_n: int) -> List[KafkaMessage]:
+        """Batch poll for the columnar block adapter: up to ``max_n``
+        messages as contiguous per-partition runs (round-robin across
+        assigned partitions), advancing the same per-partition cursors
+        ``snapshot_positions`` records — offset semantics are identical
+        to the per-message path."""
+        out: List[KafkaMessage] = []
+        for _ in range(len(self._parts)):
+            if len(out) >= max_n:
+                break
+            tp = self._parts[self._rr]
+            self._rr = (self._rr + 1) % len(self._parts)
+            run = self.broker.poll_run(tp[0], tp[1], self._pos[tp],
+                                       max_n - len(out))
+            if run:
+                self._pos[tp] += len(run)
+                out.extend(run)
+        return out
+
     def produce(self, topic, payload, partition=None, key=None) -> None:
         self.broker.produce(topic, payload, partition, key)
 
@@ -412,6 +442,35 @@ class ConfluentTransport:
         ts_us = ts[1] * 1000 if ts and ts[1] > 0 else current_time_usecs()
         return KafkaMessage(msg.topic(), msg.partition(), msg.offset(),
                             msg.value(), ts_us)
+
+    def consume_batch(self, max_n: int) -> List[KafkaMessage]:
+        """librdkafka batch poll (``Consumer.consume``); falls back to
+        repeated single polls when the client (or an injected fake)
+        lacks it. Transient per-message errors are skipped, fatal ones
+        raise — same policy as ``consume``."""
+        batch_fn = getattr(self._consumer, "consume", None)
+        if batch_fn is None:
+            out = []
+            while len(out) < max_n:
+                m = self.consume()
+                if m is None:
+                    break
+                out.append(m)
+            return out
+        msgs = _retrying(self, lambda: batch_fn(max_n, 0.01), "consume")
+        out = []
+        for msg in msgs or ():
+            err = msg.error()
+            if err is not None:
+                if getattr(err, "fatal", lambda: False)():
+                    raise WindFlowError(f"Kafka consumer error: {err}")
+                continue
+            ts = msg.timestamp()
+            ts_us = (ts[1] * 1000 if ts and ts[1] > 0
+                     else current_time_usecs())
+            out.append(KafkaMessage(msg.topic(), msg.partition(),
+                                    msg.offset(), msg.value(), ts_us))
+        return out
 
     def _ensure_producer(self):
         if self._producer is None:
@@ -578,6 +637,23 @@ class KafkaPythonTransport:
                                     r.value, ts_us)
         return None
 
+    def consume_batch(self, max_n: int) -> List[KafkaMessage]:
+        """kafka-python batch poll: one ``poll(max_records=max_n)``
+        flattened across partitions (records within a partition stay in
+        offset order)."""
+        polled = _retrying(
+            self, lambda: self._consumer.poll(timeout_ms=10,
+                                              max_records=max_n),
+            "consume")
+        out = []
+        for _tp, records in polled.items():
+            for r in records:
+                ts_us = (r.timestamp * 1000 if getattr(r, "timestamp", 0)
+                         else current_time_usecs())
+                out.append(KafkaMessage(r.topic, r.partition, r.offset,
+                                        r.value, ts_us))
+        return out
+
     def _ensure_producer(self):
         if self._producer is None:
             self._producer = self._kp.KafkaProducer(
@@ -639,7 +715,17 @@ class Kafka_Source(BasicOperator):
     """Replicas share a consumer group: partitions split across replicas;
     the user deserialization functor receives (Optional[KafkaMessage],
     shipper) and returns False to stop consuming (``kafka_source.hpp``:
-    deser functor returns a continue flag; None message = idle timeout)."""
+    deser functor returns a continue flag; None message = idle timeout).
+
+    Columnar block mode (``with_columnar_blocks`` on the builder): the
+    SAME functor slot instead receives a non-empty LIST of KafkaMessages
+    per call (one batch poll, up to ``block_size``) and is expected to
+    decode them vectorized and call ``shipper.push_columns`` — no
+    per-tuple Python on the hot path. ``None`` still signals the idle
+    timeout and ``False`` still stops. Offsets snapshot per-partition
+    exactly as in per-message mode (the batch poll advances the same
+    cursors), and barriers inject only BETWEEN polls, so the checkpoint
+    covers exactly the shipped blocks."""
 
     op_type = OpType.SOURCE
 
@@ -657,6 +743,8 @@ class Kafka_Source(BasicOperator):
         self.offsets = dict(offsets or {})
         self.idleness_ms = idleness_ms
         self._riched = arity(deser_func) >= 3
+        self.block_mode = False    # set by with_columnar_blocks
+        self.block_size = 512
         kind, _ = _parse_brokers(brokers)
         if kind != "memory":
             _require_kafka_client()
@@ -831,19 +919,35 @@ class KafkaSourceReplica(BasicReplica):
         shipper = SourceShipper(self)
         idle_budget_us = op.idleness_ms * 1000
         last_progress = current_time_usecs()
+        block_n = op.block_size if op.block_mode else 0
         while True:
             if self._coord is not None:
                 if self._coord.requested_id != self._last_ckpt:
                     self._maybe_inject()
                 self._maybe_commit()
-            msg = transport.consume()
-            if msg is not None:
-                last_progress = current_time_usecs()
-                cont = (op.deser_func(msg, shipper, self.context)
-                        if op._riched else op.deser_func(msg, shipper))
-                if cont is False:
-                    return
-                continue
+            if block_n:
+                # columnar block mode: one batch poll, the functor
+                # decodes the whole list vectorized (push_columns).
+                # Barriers land only between polls — the offsets
+                # snapshotted at injection cover exactly the blocks
+                # already shipped, same cursor semantics as per-message
+                msgs = transport.consume_batch(block_n)
+                if msgs:
+                    last_progress = current_time_usecs()
+                    cont = (op.deser_func(msgs, shipper, self.context)
+                            if op._riched else op.deser_func(msgs, shipper))
+                    if cont is False:
+                        return
+                    continue
+            else:
+                msg = transport.consume()
+                if msg is not None:
+                    last_progress = current_time_usecs()
+                    cont = (op.deser_func(msg, shipper, self.context)
+                            if op._riched else op.deser_func(msg, shipper))
+                    if cont is False:
+                        return
+                    continue
             if current_time_usecs() - last_progress > idle_budget_us:
                 # idle timeout: give the functor a chance to stop
                 cont = (op.deser_func(None, shipper, self.context)
@@ -877,6 +981,44 @@ class KafkaSourceReplica(BasicReplica):
         if not (st.inputs_received & (st.sample_every - 1)):
             self.emitter.trace_ts = current_time_usecs()
         self.emitter.emit(payload, ts, self.cur_wm)
+
+    def ship_columns(self, cols, ts_arr, wm: int) -> None:
+        """Columnar twin of ``ship`` (``shipper.push_columns`` lands
+        here): same gate / watermark / trace contract as
+        ``SourceReplica.ship_columns``, minus barrier injection — in the
+        Kafka loop barriers land between polls, never inside a block."""
+        t0_ns = time.perf_counter_ns()
+        gate = self._gate
+        if gate is not None:
+            if gate.pending:
+                # row-path records accepted into the gate's buffer
+                # precede this block: emit them first (accept-time
+                # watermarks) or the stream reorders
+                for p, t, w in gate.drain_pending():
+                    self._advance_wm(w)
+                    self._emit_admitted(p, t)
+            if gate.released:
+                self._gate = None
+            else:
+                cols, ts_arr, n = gate.offer_columns(cols, ts_arr)
+                if n == 0:
+                    return
+        if wm > self.cur_wm:
+            self.cur_wm = wm
+        st = self.stats
+        n = len(ts_arr)
+        base = st.inputs_received
+        st.inputs_received = base + n
+        trace_rows = None
+        se = st.sample_every
+        if se:
+            # vectorized mask gate — the cohort the row path would stamp
+            first = (-(base + 1)) % se
+            if first < n:
+                trace_rows = np.arange(first, n, se)
+                self.emitter.trace_ts = current_time_usecs()
+        self.emitter.emit_columns(cols, ts_arr, self.cur_wm, trace_rows)
+        st.note_ingest_block(n, time.perf_counter_ns() - t0_ns)
 
 
 

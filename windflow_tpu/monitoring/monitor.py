@@ -143,6 +143,15 @@ _PROM_SCALARS = (
      "Device programs dispatched per prepped batch (1.0 = fused "
      "baseline, < 1.0 = megabatch amortization)",
      "Programs_per_batch", 1),
+    ("windflow_ingest_blocks_total", "counter",
+     "Column blocks shipped through the columnar ingest fast path",
+     "Ingest_blocks", 1),
+    ("windflow_ingest_rows_per_block_avg", "gauge",
+     "Mean rows per ingested column block",
+     "Ingest_rows_per_block_avg", 1),
+    ("windflow_ingest_block_ns_per_row", "gauge",
+     "Host ingest cost per row on the columnar path (nanoseconds)",
+     "Ingest_block_ns_per_row", 1),
     ("windflow_queue_occupancy", "gauge",
      "Input channel occupancy (messages)", "Queue_len", 1),
     ("windflow_queue_capacity", "gauge",

@@ -39,6 +39,12 @@ class StatsRecord:
         # and the widest group observed — Programs_per_batch in to_dict
         # derives the amortization from device_programs_run
         "megabatch_loops", "megabatch_batches", "megabatch_max",
+        # columnar ingest plane (SourceReplica.ship_columns): blocks
+        # shipped, rows they carried, and host nanoseconds spent shipping
+        # them — Ingest_block_ns_per_row in to_dict is the per-row host
+        # cost of the block path (the row path has no analog: its cost
+        # IS the per-tuple Python this plane removes)
+        "ingest_blocks", "ingest_rows", "ingest_ns_total",
         # aligned-barrier checkpointing (windflow_tpu.checkpoint):
         # per-replica snapshot count/duration/size + barrier-alignment
         # stall time (multi-input workers buffering behind the barrier)
@@ -130,6 +136,9 @@ class StatsRecord:
         self.megabatch_loops = 0
         self.megabatch_batches = 0
         self.megabatch_max = 0
+        self.ingest_blocks = 0
+        self.ingest_rows = 0
+        self.ingest_ns_total = 0
         self.checkpoints_taken = 0
         self.checkpoint_snapshot_total_us = 0.0
         self.checkpoint_last_snapshot_us = 0.0
@@ -254,6 +263,16 @@ class StatsRecord:
         if self.recorder is not None:
             self.recorder.event("megabatch:scan", us, k)
 
+    def note_ingest_block(self, n_rows: int, ns: int) -> None:
+        """One column block through ``ship_columns``: ``n_rows`` admitted
+        rows shipped in ``ns`` host nanoseconds (gate + routing + staging
+        copy; the async H2D itself is excluded by dispatch)."""
+        self.ingest_blocks += 1
+        self.ingest_rows += n_rows
+        self.ingest_ns_total += ns
+        if self.recorder is not None:
+            self.recorder.event("ingest:block", ns / 1e3, n_rows)
+
     def note_dispatch_depth(self, depth: int) -> None:
         if depth > self.dispatch_depth_max:
             self.dispatch_depth_max = depth
@@ -358,6 +377,14 @@ class StatsRecord:
                 self.megabatch_batches / self.megabatch_loops, 2)
                 if self.megabatch_loops else 0.0,
             "Megabatch_max": self.megabatch_max,
+            # columnar ingest plane (0s on row-path-only sources)
+            "Ingest_blocks": self.ingest_blocks,
+            "Ingest_rows_per_block_avg": round(
+                self.ingest_rows / self.ingest_blocks, 2)
+                if self.ingest_blocks else 0.0,
+            "Ingest_block_ns_per_row": round(
+                self.ingest_ns_total / self.ingest_rows, 1)
+                if self.ingest_rows else 0.0,
             "Programs_per_batch": round(
                 self.device_programs_run / self.dispatch_batches, 3)
                 if self.dispatch_batches else 0.0,

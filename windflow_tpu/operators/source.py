@@ -9,7 +9,11 @@ equals the tuple timestamp (monotone because "now" is monotone).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
 
 from ..basic import (ExecutionMode, OpType, RoutingMode, TimePolicy,
                      WindFlowError, current_time_usecs)
@@ -53,8 +57,6 @@ class SourceShipper:
         tpu-first staging surface). On a CPU edge rows materialize as
         dicts. INGRESS_TIME stamps every row "now"; EVENT_TIME requires
         ``ts`` (int64 array, same length)."""
-        import numpy as np
-
         n = -1
         for v in cols.values():
             if n < 0:
@@ -128,6 +130,11 @@ class SourceReplica(BasicReplica):
         self._coord = None
         self._inject_cb = None  # Worker.checkpoint_now (chain-wide)
         self._last_ckpt = 0
+        # set while a multi-chunk column block is mid-flight: barriers
+        # may only land at BLOCK boundaries (the functor's cursor moves
+        # per block, so a mid-block barrier would replay already-emitted
+        # chunks after a restore — see ColumnarSourceReplica._drive)
+        self._inject_suppressed = False
         self._restore_position = None
         # overload admission control (windflow_tpu.overload): the
         # governor installs an AdmissionGate here while shedding; the
@@ -233,10 +240,7 @@ class SourceReplica(BasicReplica):
             for p, t, w in pend:
                 self._advance_wm(w)
                 self._emit_admitted(p, t)
-        if self.op._riched:
-            self.op.func(shipper, self.context)
-        else:
-            self.op.func(shipper)
+        self._drive(shipper)
         gate = self._gate
         if gate is not None and gate.pending:
             # end-of-stream with records still buffered in the admission
@@ -245,6 +249,16 @@ class SourceReplica(BasicReplica):
             for p, t, w in gate.drain_pending():
                 self._advance_wm(w)
                 self._emit_admitted(p, t)
+
+    def _drive(self, shipper: SourceShipper) -> None:
+        """Run the user functor (the generation loop). Subclasses with a
+        different functor contract (block sources) override this; the
+        restore / gate-pending / EOS-drain bracket in ``run_source``
+        stays shared."""
+        if self.op._riched:
+            self.op.func(shipper, self.context)
+        else:
+            self.op.func(shipper)
 
     def ship(self, payload: Any, ts: int, wm: int) -> None:
         # barrier BEFORE the tuple: the functor's cursor has not advanced
@@ -279,7 +293,8 @@ class SourceReplica(BasicReplica):
         self.emitter.emit(payload, ts, self.cur_wm)
 
     def ship_columns(self, cols, ts_arr, wm: int) -> None:
-        if self._coord is not None \
+        t0_ns = time.perf_counter_ns()
+        if self._coord is not None and not self._inject_suppressed \
                 and self._coord.requested_id != self._last_ckpt:
             self._maybe_inject()  # before the push, like ship()
         gate = self._gate
@@ -300,9 +315,184 @@ class SourceReplica(BasicReplica):
                     return
         if wm > self.cur_wm:
             self.cur_wm = wm
-        self.stats.inputs_received += len(ts_arr)
-        if self.stats.sample_every:
-            # columnar pushes sample at push granularity (one stamp per
-            # call): per-row stamping would defeat the no-Python fast path
-            self.emitter.trace_ts = current_time_usecs()
-        self.emitter.emit_columns(cols, ts_arr, self.cur_wm)
+        st = self.stats
+        n = len(ts_arr)
+        base = st.inputs_received
+        st.inputs_received = base + n
+        trace_rows = None
+        se = st.sample_every
+        if se:
+            # vectorized mask gate: the traced cohort is exactly the rows
+            # the row path would stamp — global positions base+1+i that
+            # are multiples of sample_every — computed as one arange, all
+            # sharing one wall-clock stamp (per-row clock reads would
+            # defeat the no-Python fast path)
+            first = (-(base + 1)) % se
+            if first < n:
+                trace_rows = np.arange(first, n, se)
+                self.emitter.trace_ts = current_time_usecs()
+        self.emitter.emit_columns(cols, ts_arr, self.cur_wm, trace_rows)
+        st.note_ingest_block(n, time.perf_counter_ns() - t0_ns)
+
+
+class Columnar_Source(Source):
+    """Schema-declared BLOCK source: the functor is a generator of column
+    blocks instead of a per-tuple push loop. Called as ``func([ctx])``,
+    it yields ``cols`` (a dict of equal-length 1-D arrays; INGRESS_TIME),
+    ``(cols, ts)`` (int64 microsecond timestamps; EVENT_TIME) or
+    ``(cols, ts, wm)`` (also advances the watermark before the push).
+    Blocks ride ``SourceReplica.ship_columns`` — barriers, the admission
+    gate, trace stamps and watermark triples all operate on block
+    boundaries, and on a device edge no per-tuple Python runs at all.
+
+    ``block_size`` (builder: ``with_block_size``; env default
+    ``WF_INGEST_BLOCK_ROWS``) re-chunks oversized yields; barriers still
+    land only at FUNCTOR-YIELD boundaries so a replayable functor's
+    block-granular cursor stays exact. ``schema`` (name -> numpy dtype)
+    canonicalizes each declared column's dtype at the edge."""
+
+    def __init__(self, func: Callable, name: str = "columnar_source",
+                 parallelism: int = 1, output_batch_size: int = 0,
+                 block_size: int = 0,
+                 schema: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(func, name, parallelism, output_batch_size)
+        if block_size <= 0:
+            try:
+                block_size = int(os.environ.get("WF_INGEST_BLOCK_ROWS", "0"))
+            except ValueError:
+                block_size = 0
+        self.block_size = max(0, block_size)
+        self.block_schema = ({k: np.dtype(v) for k, v in schema.items()}
+                             if schema else None)
+        # the block functor takes (ctx) not (shipper[, ctx]): rich means
+        # it wants the RuntimeContext
+        self._riched = arity(func) >= 1
+
+    def build_replicas(self) -> None:
+        self.replicas = [ColumnarSourceReplica(self, i)
+                         for i in range(self.parallelism)]
+
+
+class ColumnarSourceReplica(SourceReplica):
+    """Drives a block functor; everything else (restore, gate pending,
+    EOS gate drain, snapshot semantics) is the row replica's."""
+
+    def _drive(self, shipper: SourceShipper) -> None:
+        op = self.op
+        it = op.func(self.context) if op._riched else op.func()
+        if it is None:
+            return
+        bs = op.block_size
+        schema = op.block_schema
+        for block in it:
+            cols, ts, wm = _normalize_block(block)
+            if schema is not None:
+                # asarray is copy-free when the dtype already matches
+                cols = {k: (np.asarray(v, dtype=schema[k])
+                            if k in schema else v)
+                        for k, v in cols.items()}
+            if wm is not None:
+                shipper.set_next_watermark(int(wm))
+            n = 0
+            for v in cols.values():
+                n = len(v)
+                break
+            if bs and n > bs:
+                # re-chunk to the declared block size; suppress barrier
+                # injection between chunks — the functor's cursor covers
+                # whole blocks, so a mid-block barrier would double-emit
+                # the leading chunks after a restore
+                off = 0
+                try:
+                    while off < n:
+                        end = min(off + bs, n)
+                        shipper.push_columns(
+                            {k: v[off:end] for k, v in cols.items()},
+                            ts[off:end] if ts is not None else None)
+                        self._inject_suppressed = True
+                        off = end
+                finally:
+                    self._inject_suppressed = False
+            else:
+                shipper.push_columns(cols, ts)
+
+
+def _normalize_block(block):
+    """(cols, ts_or_None, wm_or_None) from a block functor yield."""
+    if isinstance(block, dict):
+        return block, None, None
+    if isinstance(block, tuple):
+        if len(block) == 2:
+            return block[0], block[1], None
+        if len(block) == 3:
+            return block
+    raise WindFlowError(
+        "Columnar_Source functor must yield cols dicts or "
+        "(cols, ts[, wm]) tuples, got " + type(block).__name__)
+
+
+class ArrayBlockSource:
+    """Replayable block functor over in-memory numpy columns: yields
+    ``block_size``-row slices. The cursor advances AFTER each yield, so
+    a barrier injected during the push snapshots a position that covers
+    exactly the blocks already shipped — the in-flight block replays
+    post-restore (exactly-once with aligned checkpointing)."""
+
+    def __init__(self, cols: Dict[str, Any], ts: Optional[Any] = None,
+                 block_size: int = 8192) -> None:
+        if block_size <= 0:
+            raise WindFlowError("ArrayBlockSource: block_size must be > 0")
+        self._cols = {k: np.asarray(v) for k, v in cols.items()}
+        n = -1
+        for v in self._cols.values():
+            if n < 0:
+                n = len(v)
+            elif len(v) != n:
+                raise WindFlowError("ArrayBlockSource: ragged columns")
+        self._ts = None if ts is None else np.asarray(ts, dtype=np.int64)
+        if self._ts is not None and len(self._ts) != max(n, 0):
+            raise WindFlowError("ArrayBlockSource: ts length mismatch")
+        self._n = max(n, 0)
+        self._bs = block_size
+        self._pos = 0
+
+    def __call__(self):
+        while self._pos < self._n:
+            lo = self._pos
+            hi = min(lo + self._bs, self._n)
+            cols = {k: v[lo:hi] for k, v in self._cols.items()}
+            if self._ts is None:
+                yield cols
+            else:
+                yield cols, self._ts[lo:hi]
+            self._pos = hi
+
+    # replayable-source protocol (block-granular cursor)
+    def snapshot_position(self) -> int:
+        return self._pos
+
+    def restore(self, position: int) -> None:
+        self._pos = int(position)
+
+
+def arrow_block_source(table, ts_column: Optional[str] = None,
+                       block_size: int = 8192) -> ArrayBlockSource:
+    """Block functor over a pyarrow Table / RecordBatch: columns convert
+    to numpy once (zero-copy where the Arrow layout allows) and stream
+    as ``ArrayBlockSource`` blocks. Gated on pyarrow being installed."""
+    try:
+        import pyarrow  # noqa: F401
+    except Exception as exc:  # pragma: no cover - depends on environment
+        raise WindFlowError(
+            "arrow_block_source requires pyarrow, which is not "
+            "available in this environment") from exc
+    tbl = table.combine_chunks() if hasattr(table, "combine_chunks") else table
+    cols = {}
+    for name in tbl.schema.names:
+        col = tbl.column(name) if hasattr(tbl, "column") else tbl[name]
+        try:
+            cols[name] = col.to_numpy(zero_copy_only=True)
+        except Exception:
+            cols[name] = col.to_numpy(zero_copy_only=False)
+    ts = cols.pop(ts_column) if ts_column else None
+    return ArrayBlockSource(cols, ts, block_size)

@@ -109,13 +109,26 @@ class BasicEmitter:
              msg_id: Optional[int] = None) -> None:
         raise NotImplementedError
 
-    def emit_columns(self, cols, ts_arr, wm: int) -> None:
+    def emit_columns(self, cols, ts_arr, wm: int, trace_rows=None) -> None:
         """Columnar push (SourceShipper.push_columns). Generic emitters
         materialize dict rows; the device staging emitter overrides this
-        with a vectorized path that never touches individual tuples."""
+        with a vectorized path that never touches individual tuples.
+        ``trace_rows`` (optional int indices into the block) marks the
+        traced cohort: each marked row re-arms ``trace_ts`` so sampling
+        matches the row path exactly."""
         names = list(cols)
         pulled = [cols[n] for n in names]
+        t0 = self.trace_ts
+        marks = None
+        nxt = -1
+        if t0 and trace_rows is not None and len(trace_rows):
+            self.trace_ts = 0
+            marks = iter(trace_rows)
+            nxt = int(next(marks, -1))
         for i in range(len(ts_arr)):
+            if i == nxt:
+                self.trace_ts = t0
+                nxt = int(next(marks, -1))
             self.emit({n: p[i].item() for n, p in zip(names, pulled)},
                       int(ts_arr[i]), wm)
 

@@ -151,6 +151,27 @@ class BatchTPU(StreamMsg):
         ts2[:n] = ts
         return BatchTPU(dev_fields, ts2, n, schema, wm, keys)
 
+    @staticmethod
+    def stage_prefilled(cols: Dict[str, np.ndarray], ts: np.ndarray,
+                        n: int, schema: TupleSchema, wm: int,
+                        keys: Optional[Any] = None,
+                        recycler=None) -> "BatchTPU":
+        """CPU->TPU from staging buffers ALREADY padded to the capacity
+        bucket and filled in place (TPUStageEmitter's block-append path):
+        just ``device_put`` — the single host copy per column happened at
+        append time. Ownership of ``cols``/``ts`` transfers to the batch:
+        the caller must not touch them again (device_put may alias the
+        host buffer); with ``recycler`` the field buffers return to its
+        pool once the H2D commits."""
+        import jax
+
+        dev_fields = {name: jax.device_put(cols[name])
+                      for name in schema.fields}
+        if recycler is not None and recycler.enabled:
+            recycler.track(dev_fields.values(),
+                           [cols[name] for name in schema.fields])
+        return BatchTPU(dev_fields, ts, n, schema, wm, keys)
+
     # -- exit to host ------------------------------------------------------
     def prefetch_host(self) -> None:
         """Start async D2H of every column (the reference's

@@ -59,6 +59,17 @@ class TPUStageEmitter(BasicEmitter):
         self._rows: List[list] = [[] for _ in range(n_bufs)]
         self._keys: List[list] = [[] for _ in range(n_bufs)]
         self._wms: List[int] = [0] * n_bufs
+        # block-native staging (append_columns): per-destination column
+        # buffers filled IN PLACE by array-slice copies — the columnar
+        # twin of ``_rows``. A buffer holds row-staged OR block-staged
+        # data, never both (the append paths ship the other form first,
+        # preserving order). Key slices accumulate as parts and are
+        # concatenated once per flush.
+        self._cbuf: List[Optional[dict]] = [None] * n_bufs
+        self._cts: List[Optional[np.ndarray]] = [None] * n_bufs
+        self._ckparts: List[list] = [[] for _ in range(n_bufs)]
+        self._ccount: List[int] = [0] * n_bufs
+        self._ccap = 0  # capacity bucket of the block staging buffers
         # per-buffer min/max origin stamps of traced rows (latency tracing)
         self._trace_lo: List[int] = [0] * n_bufs
         self._trace_hi: List[int] = [0] * n_bufs
@@ -105,6 +116,8 @@ class TPUStageEmitter(BasicEmitter):
                if self.key_extractor is not None else None)
         buf = (_dest_of_key(key, self.num_dests)
                if self.routing == "keyby" else 0)
+        if self._ccount[buf]:
+            self._ship(buf)  # block-staged partials precede this row
         rows = self._rows[buf]
         if not rows:
             self._wms[buf] = wm
@@ -124,36 +137,46 @@ class TPUStageEmitter(BasicEmitter):
             self._keys[buf].append(key)
         if len(rows) >= self.output_batch_size:
             self._ship(buf)
-        if self._stage_age_s is not None:
-            # sweep EVERY buffer: under keyby routing a shifted key
-            # distribution must not park another buffer's partial batch
-            # past the bound (the idle tick never fires on a busy stream).
-            # AMORTIZED with a rate-ADAPTIVE cadence — a per-row
-            # monotonic() + O(num_dests) loop is measurable at tens of
-            # millions of rows/sec, but a fixed row count would let a
-            # saturated-but-SLOW stream (queue never empty, so no idle
-            # ticks) overshoot the bound by rows_per_sweep/rate. Each
-            # sweep re-targets ~[age/8, age/2] between sweeps: fast
-            # streams settle at the 256-row cap (clock read every ~µs
-            # of work), slow ones walk down toward per-row checks,
-            # where the clock read is negligible at their rate.
-            self._sweep_countdown -= 1
-            if self._sweep_countdown <= 0:
-                now = time.monotonic()
-                dt = now - self._last_sweep
-                self._last_sweep = now
-                if dt > self._stage_age_s / 2:
-                    self._sweep_every = max(1, self._sweep_every // 8)
-                elif dt < self._stage_age_s / 8:
-                    self._sweep_every = min(self._SWEEP_EVERY,
-                                            self._sweep_every * 2)
-                self._sweep_countdown = self._sweep_every
-                for b in range(len(self._rows)):
-                    t0 = self._first_append[b]
-                    if self._rows[b] and t0 is not None \
-                            and now - t0 >= self._stage_age_s:
-                        self._ship(b)
+        self._sweep_tick(1)
         self._maybe_generate_punctuation(wm)
+
+    def _sweep_tick(self, n_rows: int) -> None:
+        """Staging-age sweep bookkeeping, hoisted to once per append CALL
+        on the row path and once per BLOCK on the columnar path (the
+        countdown decrements by the rows the call staged, so the adaptive
+        cadence sees the same row counts as per-row bookkeeping would).
+
+        Sweep EVERY buffer: under keyby routing a shifted key
+        distribution must not park another buffer's partial batch
+        past the bound (the idle tick never fires on a busy stream).
+        AMORTIZED with a rate-ADAPTIVE cadence — a per-row
+        monotonic() + O(num_dests) loop is measurable at tens of
+        millions of rows/sec, but a fixed row count would let a
+        saturated-but-SLOW stream (queue never empty, so no idle
+        ticks) overshoot the bound by rows_per_sweep/rate. Each
+        sweep re-targets ~[age/8, age/2] between sweeps: fast
+        streams settle at the 256-row cap (clock read every ~µs
+        of work), slow ones walk down toward per-row checks,
+        where the clock read is negligible at their rate."""
+        if self._stage_age_s is None:
+            return
+        self._sweep_countdown -= n_rows
+        if self._sweep_countdown > 0:
+            return
+        now = time.monotonic()
+        dt = now - self._last_sweep
+        self._last_sweep = now
+        if dt > self._stage_age_s / 2:
+            self._sweep_every = max(1, self._sweep_every // 8)
+        elif dt < self._stage_age_s / 8:
+            self._sweep_every = min(self._SWEEP_EVERY,
+                                    self._sweep_every * 2)
+        self._sweep_countdown = self._sweep_every
+        for b in range(len(self._rows)):
+            t0 = self._first_append[b]
+            if t0 is not None and now - t0 >= self._stage_age_s \
+                    and (self._rows[b] or self._ccount[b]):
+                self._ship(b)
 
     def on_idle(self) -> bool:
         """Worker idle tick: ship partial batches older than the staging
@@ -164,31 +187,73 @@ class TPUStageEmitter(BasicEmitter):
         did = False
         for buf in range(len(self._rows)):
             t0 = self._first_append[buf]
-            if self._rows[buf] and t0 is not None \
-                    and now - t0 >= self._stage_age_s:
+            if t0 is not None and now - t0 >= self._stage_age_s \
+                    and (self._rows[buf] or self._ccount[buf]):
                 self._ship(buf)
                 did = True
         return did
 
     def _ship(self, buf: int) -> None:
+        if self._ccount[buf]:
+            self._ship_cbuf(buf)
         rows = self._rows[buf]
         if not rows:
             return
+        rec = self.stats.recorder if self.stats is not None else None
+        t0 = time.perf_counter_ns() if rec is not None else 0
         keys = self._keys[buf] if self.key_extractor is not None else None
         batch = BatchTPU.stage(rows, self.schema, self._wms[buf], keys,
                                bucket_capacity(self.output_batch_size
                                                if len(rows) <= self.output_batch_size
                                                else len(rows)),
                                recycler=self.recycler)
+        n = len(rows)
+        self._rows[buf] = []
+        self._keys[buf] = []
+        if rec is not None:
+            # host batch construction IS this plane's host_prep: the
+            # rows -> columns encode + pad + device_put
+            rec.event("host_prep", (time.perf_counter_ns() - t0) / 1e3, n)
+        self._dispatch_batch(buf, batch, n)
+
+    def _ship_cbuf(self, buf: int) -> None:
+        """Ship a block-staged buffer: the staging arrays were filled in
+        place by ``_append_part`` (already padded to the capacity bucket),
+        so the only work left is the key-part concatenation — ONE
+        ``np.concatenate`` per flush — and the device_put."""
+        n = self._ccount[buf]
+        if not n:
+            return
+        rec = self.stats.recorder if self.stats is not None else None
+        t0 = time.perf_counter_ns() if rec is not None else 0
+        kparts = self._ckparts[buf]
+        keys = None
+        if kparts:
+            keys = kparts[0] if len(kparts) == 1 else np.concatenate(kparts)
+        batch = BatchTPU.stage_prefilled(
+            self._cbuf[buf], self._cts[buf], n, self.schema,
+            self._wms[buf], keys, self.recycler)
+        if rec is not None:
+            # block-staged host_prep: buffers already filled in place, so
+            # this is the key concat + device_put only
+            rec.event("host_prep", (time.perf_counter_ns() - t0) / 1e3, n)
+        # ownership of the staging buffers moved to the batch/recycler:
+        # a fresh set is allocated at the next append (device_put may
+        # alias the host buffer on the CPU backend)
+        self._cbuf[buf] = None
+        self._cts[buf] = None
+        self._ckparts[buf] = []
+        self._ccount[buf] = 0
+        self._dispatch_batch(buf, batch, n)
+
+    def _dispatch_batch(self, buf: int, batch: BatchTPU, n: int) -> None:
         if self.stats is not None:
-            self.stats.outputs_sent += len(rows)
+            self.stats.outputs_sent += n
             self.stats.device_bytes_h2d += batch.nbytes()
             self._update_pool_stats()
         batch.trace_min = self._trace_lo[buf]
         batch.trace_max = self._trace_hi[buf]
         self._trace_lo[buf] = self._trace_hi[buf] = 0
-        self._rows[buf] = []
-        self._keys[buf] = []
         self._first_append[buf] = None
         if self.routing == "keyby":
             batch.id = self._next_ids[buf]
@@ -213,94 +278,177 @@ class TPUStageEmitter(BasicEmitter):
         self.recycler.drain()
 
     # -- columnar fast path (push_columns) -----------------------------
-    def emit_columns(self, cols, ts_arr, wm: int) -> None:
-        """Vectorized staging: whole numpy columns -> one BatchTPU per
-        destination with no per-tuple Python. KEYBY partitions with numpy
-        when the key is a string field OR a composite tuple of field
-        names (stacked-column FNV fold); other extractors fall back to
-        the generic per-row path."""
-        import numpy as np
-
+    def emit_columns(self, cols, ts_arr, wm: int, trace_rows=None) -> None:
+        """Columnar push entry: delegates to the block-native
+        ``append_columns`` fast path. KEYBY with an arbitrary callable
+        key extractor (no field name to hash vectorized) falls back to
+        the generic per-row path — the documented object-key cliff
+        (PERF.md)."""
         if self.routing == "keyby" and self.key_field is None \
                 and self.key_fields is None:
-            return super().emit_columns(cols, ts_arr, wm)
+            return super().emit_columns(cols, ts_arr, wm, trace_rows)
+        self.append_columns(cols, ts_arr, wm, trace_rows)
+
+    def append_columns(self, cols, ts_arr, wm: int, trace_rows=None) -> None:
+        """Block-native staging: buffer array SLICES instead of per-row
+        list appends. Each destination's slice of the block is copied
+        once (vectorized) into a staging buffer that is already padded to
+        the output capacity bucket; a full buffer ships with no further
+        copy — ``device_put`` reads the staging array directly. KEYBY
+        routing hashes the key COLUMN once, then argsort/bincount split
+        the block into contiguous per-destination slices, so routing cost
+        is per-block, not per-row. ``trace_rows`` (int indices) marks the
+        traced cohort: a destination's ``trace_lo/hi`` fold the stamp iff
+        one of ITS rows is traced."""
+        n = len(ts_arr)
+        if n == 0:
+            return
         if self.schema is None:
             self.schema = TupleSchema(
                 {k: np.asarray(v).dtype for k, v in cols.items()})
-        # capture the columnar push's trace stamp before flush() consumes
-        # buffer state; every batch this push creates carries it
         t_trace = self.trace_ts
         self.trace_ts = 0
-        self.flush()  # row-staged partials go first (ordering)
-        n = len(ts_arr)
+        tmask = None
+        if t_trace and trace_rows is not None and len(trace_rows):
+            # None tmask + a stamp means "whole block traced" (legacy
+            # per-push stamping); an explicit cohort builds the row mask
+            tmask = np.zeros(n, dtype=bool)
+            tmask[trace_rows] = True
         if self.routing == "keyby":
-            if self.key_field is not None:
-                kcol = np.asarray(cols[self.key_field])
-                dests = None
-                if _int_keys_hashable_as_identity(kcol, n):
-                    # hash(n) == n for ints in [0, 2^61-1): the vectorized
-                    # modulo routes identically to the per-tuple hash of
-                    # the CPU/TPU keyby emitters
-                    dests = kcol.astype(np.int64) % self.num_dests
-                elif kcol.dtype.kind in "SU":
-                    dests = _bytes_key_dests(kcol, n, self.num_dests)
+            kcol, dests = self._block_dests(cols, n)
+            if self.num_dests == 1:
+                self._append_part(0, {k: np.asarray(v) for k, v in
+                                      cols.items()},
+                                  ts_arr, np.array(kcol), wm, t_trace,
+                                  tmask)
             else:
-                # composite multi-field key: a structured (void) column
-                # carries the key downstream; routing is the vectorized
-                # per-field FNV fold over the same structured form
-                kcol = _stack_key_fields(cols, self.key_fields, n)
-                dests = _vector_key_dests(kcol, n, self.num_dests)
-            if dests is None:
-                # object keys (mixed types): the per-row Python cliff —
-                # documented + bounded in PERF.md
-                dests = np.fromiter(
-                    (_dest_of_key(k, self.num_dests)
-                     for k in kcol.tolist()),
-                    dtype=np.int64, count=n)
-            for d in range(self.num_dests):
-                idx = np.nonzero(dests == d)[0]
-                if idx.size == 0:
-                    continue
-                sub = {k: np.asarray(v)[idx] for k, v in cols.items()}
-                b = BatchTPU.stage_columns(
-                    sub, ts_arr[idx], self.schema, wm,
-                    kcol[idx], self.recycler)
-                if t_trace:
-                    b.trace_min = b.trace_max = t_trace
-                self._send_device(d, b)
+                # ONE stable sort + one gather per column routes the
+                # whole block; per-destination slices are then contiguous
+                # views (zero further copies before the staging write)
+                order = np.argsort(dests, kind="stable")
+                counts = np.bincount(dests, minlength=self.num_dests)
+                scols = {k: np.asarray(v)[order] for k, v in cols.items()}
+                sts = ts_arr[order]
+                skeys = kcol[order]
+                stm = tmask[order] if tmask is not None else None
+                off = 0
+                for d in range(self.num_dests):
+                    c = int(counts[d])
+                    if c:
+                        sl = slice(off, off + c)
+                        self._append_part(
+                            d, {k: v[sl] for k, v in scols.items()},
+                            sts[sl], skeys[sl], wm, t_trace,
+                            stm[sl] if stm is not None else None)
+                    off += c
         else:
-            # copy: the caller may reuse its arrays after push_columns
             keys = None
             if self.key_field is not None:
+                # copy: the caller may reuse its arrays after push_columns
                 keys = np.array(cols[self.key_field])
             elif self.key_fields is not None:
                 keys = _stack_key_fields(cols, self.key_fields, n)
-            b = BatchTPU.stage_columns(cols, ts_arr, self.schema, wm, keys,
-                                       self.recycler)
-            if t_trace:
-                b.trace_min = b.trace_max = t_trace
-            if self.routing == "broadcast":
-                for d in range(self.num_dests):
-                    # device arrays are shared: one H2D transfer, count once
-                    self._send_device(d, b.copy_for_dest() if d else b,
-                                      count_stats=(d == 0))
-            else:
-                self._send_device(self._rr, b)
-                self._rr = (self._rr + 1) % self.num_dests
+            self._append_part(0, cols, ts_arr, keys, wm, t_trace, tmask)
+        self._sweep_tick(n)
         # punctuation cadence is per TUPLE (basic.py DEFAULT_WM_AMOUNT),
         # not per columnar push
         self._emit_count += max(0, n - 1)
         self._maybe_generate_punctuation(wm)
 
-    def _send_device(self, dest: int, batch: BatchTPU,
-                     count_stats: bool = True) -> None:
-        batch.id = self._next_ids[dest]
-        self._next_ids[dest] += 1
-        if self.stats is not None and count_stats:
-            self.stats.outputs_sent += batch.size
-            self.stats.device_bytes_h2d += batch.nbytes()
-            self._update_pool_stats()
-        self.ports[dest].send(batch)
+    def _block_dests(self, cols, n: int):
+        """(key column, destination vector) for a KEYBY block — hashed
+        vectorized where the key dtype allows, per-row only for
+        object/mixed keys."""
+        if self.key_field is not None:
+            kcol = np.asarray(cols[self.key_field])
+            dests = None
+            if _int_keys_hashable_as_identity(kcol, n):
+                # hash(n) == n for ints in [0, 2^61-1): the vectorized
+                # modulo routes identically to the per-tuple hash of
+                # the CPU/TPU keyby emitters
+                dests = kcol.astype(np.int64) % self.num_dests
+            elif kcol.dtype.kind in "SU":
+                dests = _bytes_key_dests(kcol, n, self.num_dests)
+        else:
+            # composite multi-field key: a structured (void) column
+            # carries the key downstream; routing is the vectorized
+            # per-field FNV fold over the same structured form
+            kcol = _stack_key_fields(cols, self.key_fields, n)
+            dests = _vector_key_dests(kcol, n, self.num_dests)
+        if dests is None:
+            # object keys (mixed types): the per-row Python cliff —
+            # documented + bounded in PERF.md
+            dests = np.fromiter(
+                (_dest_of_key(k, self.num_dests)
+                 for k in kcol.tolist()),
+                dtype=np.int64, count=n)
+        return kcol, dests
+
+    def _append_part(self, buf: int, pcols, pts, pkeys, wm: int,
+                     t_trace: int, tmask=None) -> None:
+        """Append one destination's slice of a column block to its
+        staging buffer, shipping whenever the buffer reaches the output
+        batch size. The single host copy per column happens here (caller
+        arrays -> staging buffer), so callers may reuse their arrays."""
+        if self._rows[buf]:
+            self._ship(buf)  # row-staged partials precede this block
+        n = len(pts)
+        obs = self.output_batch_size
+        if obs <= 0:
+            # unbatched edge: the block ships as-is (no re-batching);
+            # _dispatch_batch transfers the trace stamps, so fold them
+            # into the buffer slots it reads
+            batch = BatchTPU.stage_columns(pcols, pts, self.schema, wm,
+                                           pkeys, self.recycler)
+            if t_trace and (tmask is None or tmask.any()):
+                self._trace_lo[buf] = self._trace_hi[buf] = t_trace
+            self._wms[buf] = wm
+            self._dispatch_batch(buf, batch, n)
+            return
+        names = list(self.schema.fields)
+        off = 0
+        while off < n:
+            cb = self._cbuf[buf]
+            if cb is None:
+                cb = self._cbuf_alloc(buf)
+            cnt = self._ccount[buf]
+            if cnt == 0:
+                self._wms[buf] = wm
+                if self._stage_age_s is not None:
+                    self._first_append[buf] = time.monotonic()
+            elif wm < self._wms[buf]:
+                self._wms[buf] = wm
+            take = min(n - off, obs - cnt)
+            end = off + take
+            for name in names:
+                cb[name][cnt:cnt + take] = pcols[name][off:end]
+            self._cts[buf][cnt:cnt + take] = pts[off:end]
+            if pkeys is not None:
+                self._ckparts[buf].append(pkeys[off:end])
+            if t_trace and (tmask is None or tmask[off:end].any()):
+                if self._trace_lo[buf] == 0 or t_trace < self._trace_lo[buf]:
+                    self._trace_lo[buf] = t_trace
+                if t_trace > self._trace_hi[buf]:
+                    self._trace_hi[buf] = t_trace
+            self._ccount[buf] = cnt + take
+            off = end
+            if cnt + take >= obs:
+                self._ship_cbuf(buf)
+
+    def _cbuf_alloc(self, buf: int) -> dict:
+        cap = self._ccap
+        if cap == 0:
+            cap = self._ccap = bucket_capacity(self.output_batch_size)
+        pooled = self.recycler.enabled
+        pool = self.recycler.pool
+        cb = {name: (pool.acquire(dt, cap) if pooled
+                     else np.zeros(cap, dtype=dt))
+              for name, dt in self.schema.fields.items()}
+        self._cbuf[buf] = cb
+        # ts is NEVER pooled: it becomes the batch's ts_host metadata and
+        # lives as long as the batch itself (see BatchTPU.stage_columns)
+        self._cts[buf] = np.zeros(cap, dtype=np.int64)
+        return cb
 
 
 def _async_copy(arr: Any) -> None:
