@@ -17,6 +17,14 @@ checkpoint store, and verifies the exactly-once contract:
                        after the old runtime plane is torn down (the
                        worst point: no workers exist).
 
+The durable-recovery plane adds storage-fault rounds (``--storage``:
+truncate/bit-flip a checkpoint blob, delete a manifest, ENOSPC during
+staging, kill during the fallback-ladder walk — recovery must walk to
+the newest fully-verifying checkpoint with byte-identical exactly-once
+output) and ``device_loss`` (8-device mesh loses a chip mid-stream,
+recovers degraded onto 7, re-expands to 8 when the probe sees the
+device return).
+
 Verification: the committed segment records and the functor outputs of
 crash-run + restore-run together equal an uninterrupted golden run's —
 zero duplicates, zero loss — and for the rescale scenario the rescale
@@ -45,8 +53,13 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+STORAGE_SCENARIOS = ("storage_truncate", "storage_bitflip",
+                     "storage_manifest", "storage_enospc",
+                     "storage_ladder_kill")
+
 SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
-             "supervised_kill", "overload_kill", "mesh_kill")
+             "supervised_kill", "overload_kill", "mesh_kill") \
+    + STORAGE_SCENARIOS + ("device_loss",)
 
 
 class InjectedCrash(Exception):
@@ -61,12 +74,13 @@ class ChaosSource:
     an optional gate (the rescale scenario pauses mid-stream)."""
 
     def __init__(self, n, nk, ckpt_at=(), crash_at=None, gate_at=None,
-                 gate=None, crash_times=None):
+                 gate=None, crash_times=None, on_crash=None):
         self.n, self.nk = n, nk
         self.ckpt_at = set(ckpt_at)
         self.crash_at = crash_at
         self.gate_at, self.gate = gate_at, gate
         self.crash_times = crash_times  # None = every pass over crash_at
+        self.on_crash = on_crash  # storage scenarios corrupt the store here
         self.crashes = 0
         self.pos = 0
 
@@ -76,6 +90,8 @@ class ChaosSource:
                     and (self.crash_times is None
                          or self.crashes < self.crash_times):
                 self.crashes += 1
+                if self.on_crash is not None:
+                    self.on_crash(self.crashes)
                 raise InjectedCrash(f"killed at tuple {self.pos} "
                                     f"(crash #{self.crashes})")
             if self.gate_at is not None and self.pos == self.gate_at:
@@ -147,6 +163,185 @@ def _verify(golden, crash_res, rest_res, txn_dir):
         problems.append(f"committed segments diverge: got {len(segs)} "
                         f"records, want {len(golden)}")
     return problems
+
+
+def _corrupt_latest(store_root, rng, kind):
+    """Damage the latest COMMITTED checkpoint in place: truncate a random
+    blob to half, flip one byte of a random blob, or delete the manifest.
+    Returns the damaged checkpoint id (None when the store is empty)."""
+    from windflow_tpu.checkpoint import CheckpointStore
+
+    st = CheckpointStore(store_root)
+    cid = st.latest()
+    if cid is None:
+        return None
+    d = st._dirname(cid)
+    if kind == "manifest":
+        os.remove(os.path.join(d, "manifest.json"))
+        return cid
+    blobs = sorted(f for f in os.listdir(d) if f.endswith(".blob"))
+    path = os.path.join(d, rng.choice(blobs))
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if kind == "truncate":
+            f.truncate(max(1, size // 2))
+        else:  # bitflip
+            off = rng.randrange(size)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return cid
+
+
+def _storage_round(rng, report, workdir, scenario, golden, n, nk) -> dict:
+    """``--storage``: seeded storage-fault scenarios over the supervised
+    pipeline. The source corrupts the checkpoint store at its crash
+    point (race-free: all checkpoint epochs committed long before), and
+    supervised recovery must still produce byte-identical exactly-once
+    output:
+
+    - ``storage_truncate`` / ``storage_bitflip`` — latest checkpoint
+      damaged: digest verification rejects it, the fallback ladder
+      quarantines it and restores N-1;
+    - ``storage_manifest`` — latest checkpoint's manifest deleted: it
+      vanishes from the committed set, restore lands on N-1 directly;
+    - ``storage_enospc`` — a worker's blob write hits a full disk
+      mid-staging: that EPOCH fails loudly (``Checkpoint_storage_
+      failures``), the worker survives, and a later epoch commits;
+    - ``storage_ladder_kill`` — latest is corrupt AND the next rung is
+      killed mid-apply: the ladder must quarantine both and land on the
+      third-newest checkpoint (``Recovery_ladder_depth == 2``).
+    """
+    from windflow_tpu.checkpoint import CheckpointStore
+
+    mode = scenario[len("storage_"):]
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    # spaced positions + commit-waits below make "3 committed epochs
+    # before the crash" deterministic — the ladder scenarios need rungs
+    ckpt_at = sorted(rng.sample(range(100, int(n * 0.6), 60), 3))
+    crash_at = rng.randrange(int(n * 0.7), n - 50)
+    report.update(ckpt_at=ckpt_at, crash_at=crash_at)
+
+    def corrupt(_crash_no):
+        if mode in ("truncate", "bitflip", "manifest", "ladder_kill"):
+            kind = "bitflip" if mode == "ladder_kill" else mode
+            report["corrupted_ckpt"] = _corrupt_latest(store, rng, kind)
+
+    class StorageSource(ChaosSource):
+        # waits for each requested epoch to commit before streaming on,
+        # so the crash point always finds the full retain window on disk
+        def __call__(self, shipper):
+            st = CheckpointStore(store)
+            skip_wait = {ckpt_at[1]} if mode == "enospc" else set()
+            while self.pos < self.n:
+                if self.pos == self.crash_at and self.crashes < 1:
+                    self.crashes += 1
+                    if self.on_crash is not None:
+                        self.on_crash(self.crashes)
+                    raise InjectedCrash(f"killed at tuple {self.pos}")
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": v})
+                self.pos += 1
+                if self.pos in self.ckpt_at and self.pos not in skip_wait:
+                    before = st.latest() or 0
+                    shipper.request_checkpoint()
+                    deadline = time.time() + 10
+                    while (st.latest() or 0) <= before \
+                            and time.time() < deadline:
+                        time.sleep(0.002)
+                elif self.pos in self.ckpt_at:
+                    shipper.request_checkpoint()  # epoch that will fail
+
+    crash_res = []
+    src = StorageSource(n, nk, ckpt_at, crash_at, on_crash=corrupt)
+    g = _build(store, src, txn, crash_res, nk, supervised=True)
+
+    unpatch = []
+    if mode == "enospc":
+        # one-shot: the SECOND checkpoint epoch hits a full disk while a
+        # worker stages its blob — the epoch must abort loudly and the
+        # next interval must commit normally
+        orig_wb = CheckpointStore.write_blob
+        left = [1]
+
+        def dying_wb(self, ckpt_id, op_name, replica_idx, state):
+            if left[0] > 0 and ckpt_id >= 2:
+                left[0] -= 1
+                raise OSError(28, "No space left on device (injected)")
+            return orig_wb(self, ckpt_id, op_name, replica_idx, state)
+
+        CheckpointStore.write_blob = dying_wb
+        unpatch.append(lambda: setattr(CheckpointStore, "write_blob",
+                                       orig_wb))
+    elif mode == "ladder_kill":
+        # rung 1 dies naturally on the bit-flipped digest; the first
+        # rung whose load SUCCEEDS is then killed mid-apply — the walk
+        # must quarantine it too and land on the next one down
+        orig_ls = CheckpointStore.load_states
+        killed = [False]
+
+        def dying_ls(self, ckpt_dir, manifest):
+            states = orig_ls(self, ckpt_dir, manifest)
+            if not killed[0]:
+                killed[0] = True
+                raise InjectedCrash("killed during ladder walk "
+                                    "(mid-apply)")
+            return states
+
+        CheckpointStore.load_states = dying_ls
+        unpatch.append(lambda: setattr(CheckpointStore, "load_states",
+                                       orig_ls))
+
+    try:
+        g.run()  # recovers in-process; raising here fails the round
+    finally:
+        for u in unpatch:
+            u()
+
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    ck = st.get("Checkpoints", {})
+    problems = _verify(golden, crash_res, [], txn)
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if mode in ("truncate", "bitflip"):
+        if sup.get("Recovery_ladder_depth", 0) != 1:
+            problems.append(f"expected ladder depth 1 (latest corrupt), "
+                            f"saw {sup.get('Recovery_ladder_depth')}")
+        if sup.get("Recovery_verify_failures", 0) < 1:
+            problems.append("corrupt blob never tripped verification")
+    elif mode == "manifest":
+        # a manifest-less directory is simply not a committed checkpoint:
+        # restore lands on N-1 with no ladder walk at all
+        if sup.get("Recovery_ladder_depth", 0) != 0:
+            problems.append(f"expected ladder depth 0 (latest invisible), "
+                            f"saw {sup.get('Recovery_ladder_depth')}")
+    elif mode == "enospc":
+        if ck.get("Checkpoint_storage_failures", 0) < 1:
+            problems.append("injected ENOSPC never failed an epoch")
+        if ck.get("Checkpoint_failures", 0) < 1:
+            problems.append("storage failure not counted as epoch failure")
+        if (CheckpointStore(store).latest() or 0) < 3:
+            problems.append("no epoch committed after the ENOSPC abort")
+    elif mode == "ladder_kill":
+        if sup.get("Recovery_ladder_depth", 0) != 2:
+            problems.append(f"expected ladder depth 2 (corrupt latest + "
+                            f"mid-apply kill), saw "
+                            f"{sup.get('Recovery_ladder_depth')}")
+        if sup.get("Recovery_verify_failures", 0) < 2:
+            problems.append("ladder rung failures undercounted")
+    report.update(
+        ok=not problems, problems=problems, results=len(golden),
+        restarts=sup.get("Supervision_restarts", 0),
+        ladder_depth=sup.get("Recovery_ladder_depth", 0),
+        verify_failures=sup.get("Recovery_verify_failures", 0),
+        ckpt_verify_failures=ck.get("Checkpoint_verify_failures", 0),
+        storage_failures=ck.get("Checkpoint_storage_failures", 0),
+        mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
 
 
 def _overload_kill_round(rng, report, workdir) -> dict:
@@ -368,6 +563,168 @@ def _mesh_kill_round(rng, report, workdir) -> dict:
     return report
 
 
+def _device_loss_round(rng, report, workdir) -> dict:
+    """``device_loss``: the failover acceptance round. An 8-device mesh
+    pipeline loses a device mid-stream (static probe reports it dead,
+    the source crashes once); supervised recovery must rebuild the mesh
+    on the surviving 7 devices (``Recovery_degraded_devices == 1``,
+    replica ``Mesh_devices == 7``) with byte-identical exactly-once
+    output, then re-expand to the full 8-device shape via ONE planned
+    restart when the probe sees the device return."""
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, RestartPolicy,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.sinks.transactional import read_committed_records
+
+    import jax
+    if len(jax.devices()) < 8:
+        report.update(ok=True, skipped="needs 8 virtual devices "
+                      "(run via ensure_virtual_devices)")
+        return report
+    from windflow_tpu.mesh.core import set_excluded_devices
+    from windflow_tpu.supervision import StaticDeviceProbe
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n, nk = 4000, 7
+    crash_at = rng.randrange(int(n * 0.08), int(n * 0.12))
+    lost = int(jax.devices()[-1].id)
+    report.update(n=n, nk=nk, crash_at=crash_at, lost_device=lost)
+
+    pace = {"sleep": 0.003}       # runway so re-expansion happens live
+    release = threading.Event()   # insurance: hold the tail until the
+    hold_at = int(n * 0.9)        # 8-device plane has been observed
+
+    class PacedSource(ChaosSource):
+        def __init__(self, paced):
+            super().__init__(n, nk, crash_at=crash_at if paced else None,
+                             crash_times=1)
+            self.paced = paced
+
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                if self.crash_at is not None and self.pos == self.crash_at \
+                        and self.crashes < 1:
+                    self.crashes += 1
+                    raise InjectedCrash(f"killed at {self.pos}")
+                if self.paced and self.pos == hold_at:
+                    release.wait(30)
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": float(v + 1)})
+                self.pos += 1
+                if self.pos % 100 == 0:
+                    shipper.request_checkpoint()
+                if self.paced and pace["sleep"]:
+                    time.sleep(pace["sleep"])
+
+    def build(store, txn, src, rows, supervised, probe=None):
+        g = PipeGraph("chaos_devloss", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        if supervised:
+            g.with_supervision(RestartPolicy(max_restarts=4,
+                                             backoff_s=0.02,
+                                             backoff_max_s=0.2))
+        if probe is not None:
+            g.with_device_probe(probe)
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": row["v"],
+                                  "run": st + row["v"]}, st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_mesh(key_capacity=nk).with_name("mscan").build())
+
+        def sink(t):
+            if t is not None:
+                rows.append((int(t["k"]), float(t["v"]), float(t["run"])))
+
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(64).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk")
+                      .with_exactly_once(staging_dir=txn).build())
+        return g
+
+    def committed(txn):
+        return sorted((int(r["k"]), float(r["v"]), float(r["run"]))
+                      for r, _ in read_committed_records(
+                          os.path.join(txn, "snk_r0")))
+
+    def mesh_devices(st):
+        return max((r.get("Mesh_devices", 0)
+                    for o in st.get("Operators", [])
+                    if o["name"] == "mscan" for r in o["replicas"]),
+                   default=0)
+
+    gold_rows = []
+    build(os.path.join(workdir, "gold_store"),
+          os.path.join(workdir, "gold_txn"), PacedSource(paced=False),
+          gold_rows, supervised=False).run()
+    golden = committed(os.path.join(workdir, "gold_txn"))
+
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    probe = StaticDeviceProbe(dead=(lost,), interval_s=0.05)
+    rows = []
+    g = build(store, txn, PacedSource(paced=True), rows,
+              supervised=True, probe=probe)
+    problems = []
+    try:
+        g.start()
+        deadline = time.time() + 90
+        degraded_seen = False
+        while time.time() < deadline:
+            st = g.get_stats()
+            sup = st.get("Supervision", {})
+            if sup.get("Recovery_degraded_devices", 0) == 1 \
+                    and mesh_devices(st) == 7:
+                degraded_seen = True
+                break
+            time.sleep(0.05)
+        if not degraded_seen:
+            problems.append("degraded 7-device recovery never observed "
+                            "(Recovery_degraded_devices/Mesh_devices)")
+        probe.dead.clear()  # the device "returns"
+        reexpanded = False
+        while time.time() < deadline:
+            sup = g.get_stats().get("Supervision", {})
+            if sup.get("Supervision_planned_restarts", 0) >= 1 \
+                    and sup.get("Recovery_degraded_devices", 1) == 0:
+                reexpanded = True
+                break
+            time.sleep(0.05)
+        if not reexpanded:
+            problems.append("planned re-expansion restart never happened")
+        pace["sleep"] = 0.0
+        release.set()
+        g.wait_end()
+    finally:
+        release.set()
+        set_excluded_devices(())  # process-global registry: always reset
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 failure restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if mesh_devices(st) != 8:
+        problems.append(f"mesh did not re-expand to 8 devices "
+                        f"(final Mesh_devices {mesh_devices(st)})")
+    segs = committed(txn)
+    if segs != golden:
+        dup = len(segs) - len(set(segs))
+        lost_n = len([x for x in golden if x not in set(segs)])
+        problems.append(f"committed records diverge from golden: "
+                        f"{dup} duplicate(s), {lost_n} lost "
+                        f"(got {len(segs)}, want {len(golden)})")
+    report.update(ok=not problems, problems=problems,
+                  results=len(golden),
+                  restarts=sup.get("Supervision_restarts", 0),
+                  planned_restarts=sup.get("Supervision_planned_restarts",
+                                           0),
+                  degraded_devices=sup.get("Recovery_degraded_devices", 0),
+                  mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
+
+
 def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
               nk: int = 7) -> dict:
     """One seeded chaos round; returns a report dict with ``ok``."""
@@ -381,9 +738,14 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
         # runs its own (mesh) golden pipeline — the CPU-windows golden
         # below would be wasted work
         return _mesh_kill_round(rng, report, workdir)
+    if scenario == "device_loss":
+        return _device_loss_round(rng, report, workdir)
     golden = _golden(workdir, n, nk)
     store = os.path.join(workdir, "store")
     txn = os.path.join(workdir, "txn")
+    if scenario in STORAGE_SCENARIOS:
+        return _storage_round(rng, report, workdir, scenario, golden,
+                              n, nk)
 
     if scenario == "kill_point":
         n_ckpts = rng.randint(1, 3)
@@ -529,7 +891,7 @@ def run_sweep(seed: int, rounds: int, scenarios=SCENARIOS,
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
-    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--rounds", type=int, default=len(SCENARIOS))
     ap.add_argument("--n", type=int, default=2000,
                     help="tuples per round (default 2000)")
     ap.add_argument("--scenario", choices=SCENARIOS, default=None,
@@ -551,6 +913,13 @@ def main() -> int:
                          "supervision ON): the sharded state must restore "
                          "from its per-shard checkpoint blocks with "
                          "byte-identical exactly-once output")
+    ap.add_argument("--storage", action="store_true",
+                    help="seeded storage-fault scenarios (truncate blob, "
+                         "bit-flip blob, delete manifest, ENOSPC during "
+                         "staging, kill during the ladder walk): "
+                         "supervised recovery must walk the fallback "
+                         "ladder and keep the exactly-once output "
+                         "byte-identical")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (e.g. "
                          "results/chaos.json)")
@@ -565,6 +934,8 @@ def main() -> int:
         scenarios = ("overload_kill",)
     elif args.mesh:
         scenarios = ("mesh_kill",)
+    elif args.storage:
+        scenarios = STORAGE_SCENARIOS
     else:
         scenarios = (args.scenario,) if args.scenario else SCENARIOS
     report = run_sweep(args.seed, args.rounds, scenarios, n=args.n)

@@ -68,6 +68,12 @@ REQUIRED_FAMILIES = (
     # restart: an injected source crash the supervisor recovers from)
     "windflow_restart_total",
     "windflow_restart_last_seconds",
+    # durable-recovery plane: fallback-ladder + device-loss signals
+    # (0-valued on a clean run, but the families must export)
+    "windflow_recovery_ladder_depth",
+    "windflow_recovery_verify_failures_total",
+    "windflow_recovery_degraded_devices",
+    "windflow_ckpt_verify_failures_total",
     # dead-letter / error-policy + Kafka retry accounting (per-replica
     # scalars: present with value 0 on every replica when unused)
     "windflow_dlq_records_total",

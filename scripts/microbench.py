@@ -470,6 +470,109 @@ def bench_checkpoint() -> None:
                           "align_stall_usec_total": round(stall, 1)}))
 
 
+def bench_verify() -> None:
+    """--verify: checkpoint content-digest overhead (``WF_CKPT_VERIFY``,
+    windflow_tpu.checkpoint.store) at the --checkpoint 10 s interval
+    config. A/B passes with verification on (sha256 of every blob
+    payload at write time + digests folded into the manifest) vs off,
+    interleaved best-of-N like --checkpoint; the delta is the acceptance
+    gate (<= 2% throughput at the 10 s interval). Also reports the raw
+    sha256 rate and the bytes hashed per checkpoint, so the gate's
+    headroom is legible: digest cost = bytes_per_ckpt / rate, amortized
+    over the interval."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from windflow_tpu import (ExecutionMode, Keyed_Windows, PipeGraph,
+                              Sink_Builder, Source_Builder, TimePolicy,
+                              WinType)
+
+    TARGET_S = float(os.environ.get("WF_MB_CKPT_SECS", "12"))
+    REPS = int(os.environ.get("WF_MB_CKPT_REPS", "5"))
+    NK = 64
+
+    class TimedSource:
+        def __init__(self):
+            self.pos = 0
+
+        def __call__(self, shipper):
+            t0 = time.perf_counter()
+            while True:
+                v = self.pos
+                shipper.push({"k": v % NK, "v": v})
+                self.pos += 1
+                if (self.pos & 2047) == 0 and \
+                        time.perf_counter() - t0 >= TARGET_S:
+                    return
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    def one_pass(verify):
+        os.environ["WF_CKPT_VERIFY"] = "1" if verify else "0"
+        src = TimedSource()
+        g = PipeGraph("mb_verify", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        tmp = tempfile.mkdtemp(prefix="wf_mb_verify_")
+        g.with_checkpointing(interval=10.0, store_dir=tmp)
+        win = Keyed_Windows(lambda rows: sum(r["v"] for r in rows),
+                            key_extractor=lambda t: t["k"], win_len=16,
+                            slide_len=16, win_type=WinType.CB, name="kw",
+                            parallelism=2)
+        g.add_source(Source_Builder(src).with_name("src").build()) \
+            .add(win) \
+            .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+        t0 = time.perf_counter()
+        g.run()
+        elapsed = time.perf_counter() - t0
+        stats = g.get_stats()
+        shutil.rmtree(tmp, ignore_errors=True)
+        return src.pos / elapsed, stats
+
+    prior = os.environ.get("WF_CKPT_VERIFY")
+    best = {"off": (0.0, None), "on": (0.0, None)}
+    try:
+        for _ in range(REPS):
+            for label, verify in (("off", False), ("on", True)):
+                tps, st = one_pass(verify)
+                if tps > best[label][0]:
+                    best[label] = (tps, st)
+    finally:
+        if prior is None:
+            os.environ.pop("WF_CKPT_VERIFY", None)
+        else:
+            os.environ["WF_CKPT_VERIFY"] = prior
+
+    for label in ("off", "on"):
+        report(f"ckpt_verify_{label}", best[label][0])
+    base = best["off"][0]
+    pct = 100.0 * (1.0 - best["on"][0] / base) if base else 0.0
+    print(json.dumps({"bench": "ckpt_verify_overhead_pct",
+                      "value": round(pct, 2), "unit": "pct",
+                      "acceptance": "<=2% at 10s interval"}))
+
+    # raw digest throughput: how fast the write path hashes a payload
+    buf = os.urandom(1 << 23)  # 8 MiB, incompressible
+    rate = 0.0
+    for _ in range(5):
+        t0 = time.perf_counter()
+        hashlib.sha256(buf).hexdigest()
+        rate = max(rate, len(buf) / (time.perf_counter() - t0))
+    report("ckpt_digest_sha256_rate_gb_s", rate / 1e9, "GB/s")
+    ck = (best["on"][1] or {}).get("Checkpoints", {})
+    completed = ck.get("Checkpoints_completed", 0) or 1
+    nbytes = ck.get("Checkpoint_bytes_total", 0)
+    print(json.dumps({"bench": "ckpt_verify_bytes_hashed",
+                      "checkpoints": ck.get("Checkpoints_completed", 0),
+                      "bytes_per_checkpoint": round(nbytes / completed, 1),
+                      "amortized_hash_usec_per_10s":
+                          round((nbytes / completed) / rate * 1e6, 2)}))
+
+
 def bench_txn() -> None:
     """--txn: exactly-once sink overhead (windflow_tpu.sinks.
     transactional) on the checkpointed keyed-windows pipeline.
@@ -1340,6 +1443,9 @@ def main() -> None:
         return
     if "--txn" in sys.argv[1:]:
         bench_txn()
+        return
+    if "--verify" in sys.argv[1:]:
+        bench_verify()
         return
     if "--fusion" in sys.argv[1:]:
         bench_fusion()

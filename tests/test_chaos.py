@@ -73,9 +73,60 @@ def test_chaos_mesh_kill(tmp_path):
     assert rep["restarts"] == 1
 
 
+@pytest.mark.parametrize("scenario", ["storage_truncate", "storage_bitflip",
+                                      "storage_manifest"])
+def test_chaos_storage_corruption(tmp_path, scenario):
+    """Corrupt the latest checkpoint at the crash point: digest
+    verification rejects it (or the manifest-less directory simply
+    vanishes from the committed set), the fallback ladder restores the
+    next-older checkpoint, and the exactly-once output stays
+    byte-identical to an uninterrupted run."""
+    rep = chaos.run_round(13, scenario, str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] == 1
+    if scenario != "storage_manifest":
+        assert rep["ladder_depth"] == 1
+        assert rep["verify_failures"] >= 1
+
+
+def test_chaos_storage_enospc(tmp_path):
+    """A full disk while a worker stages its snapshot fails that EPOCH
+    loudly (``Checkpoint_storage_failures``) without killing the worker;
+    the next interval commits and recovery stays byte-identical."""
+    rep = chaos.run_round(17, "storage_enospc", str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+    assert rep["storage_failures"] >= 1
+
+
+def test_chaos_storage_ladder_kill(tmp_path):
+    """Corrupt latest AND kill the next rung mid-apply: the ladder
+    quarantines both and lands on the third-newest checkpoint
+    (``Recovery_ladder_depth == 2``), still byte-identical."""
+    rep = chaos.run_round(29, "storage_ladder_kill", str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+    assert rep["ladder_depth"] == 2
+    assert rep["verify_failures"] >= 2
+
+
+@pytest.mark.mesh
+def test_chaos_device_loss(tmp_path):
+    """The failover acceptance round: an 8-device mesh loses a chip
+    mid-stream, recovers degraded onto the surviving 7 devices
+    (``Recovery_degraded_devices == 1``) byte-identically, then
+    re-expands to 8 via one planned restart when the probe sees the
+    device return."""
+    rep = chaos.run_round(9, "device_loss", str(tmp_path))
+    assert rep["ok"], rep["problems"]
+    assert rep.get("skipped") is None
+    assert rep["restarts"] == 1
+    assert rep["planned_restarts"] >= 1
+    assert rep["degraded_devices"] == 0  # back to full shape at the end
+
+
 @pytest.mark.slow
 def test_chaos_sweep(tmp_path):
-    rep = chaos.run_sweep(31, rounds=6, workdir=str(tmp_path))
+    rep = chaos.run_sweep(31, rounds=len(chaos.SCENARIOS),
+                          workdir=str(tmp_path))
     assert rep["ok"], [r for r in rep["rounds"] if not r["ok"]]
 
 

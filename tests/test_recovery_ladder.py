@@ -1,0 +1,307 @@
+"""Durable-recovery plane: checkpoint integrity verification and the
+supervisor's fallback-ladder restore.
+
+Unit level: ``CheckpointStore`` digests (typed ``CorruptCheckpointError``
+naming the bad blob, the ``verify()`` report, ``quarantine``, the
+pre-digest-manifest warning, the ``WF_CKPT_VERIFY`` knob) and the
+coordinator's loud-but-contained handling of a storage failure during
+staging.
+
+Property level (the differential test): over a retain-3 store, ANY
+seeded subset of the committed checkpoints corrupted at the crash point
+— including all of them — supervised recovery lands on the newest fully
+verifying checkpoint (or captured-initial full replay) with exactly-once
+output byte-identical to an uninterrupted golden run, and
+``Recovery_ladder_depth`` equals the number of corrupt rungs walked.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import chaos  # noqa: E402  (scripts/chaos.py)
+
+from windflow_tpu.checkpoint import (CheckpointStore,  # noqa: E402
+                                     CorruptCheckpointError)
+
+
+def _make_store(root, n_ckpts=3, retain=3):
+    st = CheckpointStore(str(root), retain=retain)
+    for cid in range(1, n_ckpts + 1):
+        st.begin(cid)
+        st.write_blob(cid, "op_a", 0, {"pos": cid * 10})
+        st.write_blob(cid, "kw", 1, {"acc": list(range(cid))})
+        st.commit(cid, {})
+    return st
+
+
+def _blob_paths(st, cid):
+    d = st._dirname(cid)
+    return [os.path.join(d, f) for f in sorted(os.listdir(d))
+            if f.endswith(".blob")]
+
+
+# -- store-level integrity ---------------------------------------------------
+
+def test_corrupt_blob_raises_typed_error_naming_blob(tmp_path):
+    st = _make_store(tmp_path)
+    path = _blob_paths(st, 3)[0]
+    with open(path, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(path) // 2))
+    d = st._dirname(3)
+    manifest = st.load_manifest(d)
+    with pytest.raises(CorruptCheckpointError) as ei:
+        st.load_states(d, manifest)
+    assert os.path.basename(path) in str(ei.value)
+    assert "digest mismatch" in str(ei.value)
+    assert st.verify_failures == 1
+
+
+def test_appended_garbage_is_caught_by_digest_only(tmp_path, monkeypatch):
+    """Appended bytes keep the pickle loadable (pickle stops at the end
+    of the object) — ONLY the digest catches this corruption, and
+    ``WF_CKPT_VERIFY=0`` lets it through."""
+    st = _make_store(tmp_path)
+    path = _blob_paths(st, 2)[0]
+    with open(path, "ab") as f:
+        f.write(b"\x00torn-write-garbage")
+    d = st._dirname(2)
+    manifest = st.load_manifest(d)
+    with pytest.raises(CorruptCheckpointError):
+        st.load_states(d, manifest)
+    monkeypatch.setenv("WF_CKPT_VERIFY", "0")
+    states = st.load_states(d, manifest)
+    assert states[("op_a", 0)] == {"pos": 20}
+
+
+def test_verify_report_surveys_damage_without_raising(tmp_path):
+    st = _make_store(tmp_path)
+    rep = st.verify()
+    assert sorted(rep) == [1, 2, 3]
+    assert all(r["ok"] and r["digested"] and r["blobs"] == 2
+               and r["bytes"] > 0 for r in rep.values())
+    path = _blob_paths(st, 3)[1]
+    with open(path, "r+b") as f:
+        f.seek(3)
+        f.write(b"\xff")
+    rep = st.verify()
+    assert rep[1]["ok"] and rep[2]["ok"]
+    assert not rep[3]["ok"]
+    assert any("digest mismatch" in p for p in rep[3]["problems"])
+    # single-checkpoint form
+    assert not st.verify(3)[3]["ok"]
+
+
+def test_quarantine_hides_checkpoint_from_restore(tmp_path):
+    st = _make_store(tmp_path)
+    dst = st.quarantine(3)
+    assert dst is not None and dst.endswith(".corrupt")
+    assert os.path.isdir(dst)  # kept for post-mortem
+    assert st.completed_ids() == [1, 2]
+    assert st.latest() == 2
+    assert st.quarantine(3) is None  # already gone
+
+
+def test_undigested_manifest_restores_with_warning(tmp_path, monkeypatch):
+    monkeypatch.setenv("WF_CKPT_VERIFY", "0")
+    st = _make_store(tmp_path, n_ckpts=1)
+    d = st._dirname(1)
+    manifest = st.load_manifest(d)
+    assert "digests" not in manifest  # knob off at write time
+    monkeypatch.setenv("WF_CKPT_VERIFY", "1")
+    with pytest.warns(RuntimeWarning, match="no content digests"):
+        states = st.load_states(d, manifest)
+    assert states[("kw", 1)] == {"acc": [0]}
+
+
+def test_manifest_digests_cover_every_blob(tmp_path):
+    st = _make_store(tmp_path, n_ckpts=1)
+    manifest = st.load_manifest(st._dirname(1))
+    assert sorted(manifest["digests"]) == sorted(manifest["blobs"])
+    assert all(v.startswith("sha256:") for v in manifest["digests"].values())
+
+
+def test_garbled_manifest_raises_typed_error(tmp_path):
+    st = _make_store(tmp_path, n_ckpts=1)
+    mpath = os.path.join(st._dirname(1), "manifest.json")
+    with open(mpath, "w") as f:
+        f.write('{"ckpt_id": 1, "blobs": [TORN')
+    with pytest.raises(CorruptCheckpointError, match="undecodable"):
+        st.load_manifest(st._dirname(1))
+
+
+# -- coordinator: storage failure fails the epoch, not the worker ------------
+
+def test_storage_failure_fails_epoch_not_worker(tmp_path, monkeypatch):
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+
+    class Src:
+        def __init__(self):
+            self.pos = 0
+
+        def __call__(self, shipper):
+            while self.pos < 400:
+                shipper.push({"v": self.pos})
+                self.pos += 1
+                if self.pos in (100, 300):
+                    shipper.request_checkpoint()
+                    time.sleep(0.05)  # let the epoch settle
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    orig = CheckpointStore.write_blob
+    fail_left = [1]
+
+    def dying(self, ckpt_id, op_name, replica_idx, state):
+        if ckpt_id == 1 and fail_left[0] > 0:
+            fail_left[0] -= 1
+            raise OSError(28, "No space left on device (injected)")
+        return orig(self, ckpt_id, op_name, replica_idx, state)
+
+    monkeypatch.setattr(CheckpointStore, "write_blob", dying)
+    out = []
+    store = str(tmp_path / "store")
+    g = PipeGraph("t", ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=store)
+    g.add_source(Source_Builder(Src()).with_name("src").build()) \
+        .add_sink(Sink_Builder(lambda t: out.append(t)).with_name("snk")
+                  .build())
+    g.run()  # the OSError must NOT propagate out of the worker
+    assert len([t for t in out if t is not None]) == 400
+    ck = g.get_stats()["Checkpoints"]
+    assert ck["Checkpoint_storage_failures"] >= 1
+    assert ck["Checkpoint_failures"] >= 1
+    # epoch 1 aborted and its staging debris is gone; epoch 2 committed
+    st = CheckpointStore(store)
+    assert st.latest() == 2
+    assert not os.path.isdir(st._dirname(1, staging=True))
+
+
+# -- the differential property: random corruption subsets --------------------
+
+_KINDS = ("truncate", "bitflip", "append")
+
+
+def _damage(store_root, cid, kind, rng):
+    st = CheckpointStore(store_root)
+    d = st._dirname(cid)
+    blobs = sorted(f for f in os.listdir(d) if f.endswith(".blob"))
+    path = os.path.join(d, rng.choice(blobs))
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if kind == "truncate":
+            f.truncate(max(1, size // 2))
+        elif kind == "append":
+            f.seek(0, 2)
+            f.write(b"\x00torn")
+        else:
+            off = rng.randrange(size)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+
+class _WaitingSource(chaos.ChaosSource):
+    """ChaosSource that waits for each requested epoch to commit, so the
+    crash point deterministically finds all three checkpoints on disk
+    (and the full-replay pass recreates them at the same positions)."""
+
+    def __init__(self, store_root, *a, **kw):
+        super().__init__(*a, **kw)
+        self.store_root = store_root
+
+    def __call__(self, shipper):
+        st = CheckpointStore(self.store_root)
+        while self.pos < self.n:
+            if self.pos == self.crash_at and self.crashes < 1:
+                self.crashes += 1
+                if self.on_crash is not None:
+                    self.on_crash(self.crashes)
+                raise chaos.InjectedCrash(f"killed at {self.pos}")
+            shipper.push({"k": self.pos % self.nk, "v": self.pos})
+            self.pos += 1
+            if self.pos in self.ckpt_at:
+                before = st.latest() or 0
+                shipper.request_checkpoint()
+                t0 = time.time()
+                while (st.latest() or 0) <= before and time.time() - t0 < 10:
+                    time.sleep(0.002)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+def test_ladder_lands_on_newest_verifying_checkpoint(tmp_path, seed):
+    rng = random.Random(seed)
+    n, nk = 1500, 7
+    golden = chaos._golden(str(tmp_path), n, nk)
+    store = os.path.join(str(tmp_path), "store")
+    txn = os.path.join(str(tmp_path), "txn")
+    ckpt_at = [250, 500, 750]
+    crash_at = 1200
+    # seed 5 pins the worst case: every checkpoint corrupt -> full replay
+    subset = ([1, 2, 3] if seed == 5
+              else sorted(rng.sample([1, 2, 3], rng.randint(1, 3))))
+    kinds = {cid: rng.choice(_KINDS) for cid in subset}
+
+    def corrupt(_crash_no):
+        for cid in subset:
+            _damage(store, cid, kinds[cid], rng)
+
+    res = []
+    src = _WaitingSource(store, n, nk, ckpt_at, crash_at, crash_times=1,
+                         on_crash=corrupt)
+    g = chaos._build(store, src, txn, res, nk, supervised=True)
+    g.run()  # recovers in-process
+
+    sup = g.get_stats()["Supervision"]
+    newest_good = max((c for c in (1, 2, 3) if c not in subset),
+                      default=None)
+    # the ladder only ever touches rungs NEWER than where it lands, and
+    # every one of those is corrupt by construction
+    expected_depth = 3 - newest_good if newest_good is not None else 3
+    assert sup["Supervision_restarts"] == 1
+    assert sup["Recovery_ladder_depth"] == expected_depth, (subset, kinds)
+    assert sup["Recovery_verify_failures"] == expected_depth
+    problems = chaos._verify(golden, res, [], txn)
+    assert problems == [], (subset, kinds, problems)
+
+
+# -- device-loss plane: the mesh exclusion registry --------------------------
+
+@pytest.mark.mesh
+def test_exclusion_registry_clamps_mesh():
+    import jax
+
+    from windflow_tpu.mesh.core import (excluded_device_ids,
+                                        healthy_devices, make_key_mesh,
+                                        set_excluded_devices)
+
+    n_dev = len(jax.devices())
+    lost = int(jax.devices()[-1].id)
+    try:
+        set_excluded_devices({lost})
+        assert excluded_device_ids() == frozenset({lost})
+        alive = healthy_devices()
+        assert len(alive) == n_dev - 1
+        assert lost not in {int(d.id) for d in alive}
+        mesh = make_key_mesh(n_dev)  # asks for full shape, gets survivors
+        assert mesh.devices.size == n_dev - 1
+        # a probe gone mad must never produce a zero-device mesh
+        set_excluded_devices([int(d.id) for d in jax.devices()])
+        assert len(healthy_devices()) == n_dev
+    finally:
+        set_excluded_devices(())
+    assert excluded_device_ids() == frozenset()
+    assert make_key_mesh(n_dev).devices.size == n_dev

@@ -30,6 +30,7 @@ from .builders import (Columnar_Source_Builder, Ffat_Windows_Builder,
                        MapReduce_Windows_Builder, Paned_Windows_Builder,
                        Parallel_Windows_Builder, Reduce_Builder, Sink_Builder,
                        Source_Builder)
+from .checkpoint import CorruptCheckpointError
 from .context import LocalStorage, RuntimeContext
 from .message import Batch, Single
 from .operators.basic_ops import (Filter, FlatMap, Map, Reduce, Shipper, Sink)
@@ -53,7 +54,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ExecutionMode", "TimePolicy", "WinType", "RoutingMode", "JoinMode",
-    "WindFlowError", "FencedWriteError",
+    "WindFlowError", "FencedWriteError", "CorruptCheckpointError",
     "PipeGraph", "MultiPipe",
     "Source", "Columnar_Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
     "SourceShipper", "Shipper",

@@ -28,6 +28,7 @@ calls per replica, not a per-operator serializer.
 """
 
 from .coordinator import CheckpointCoordinator
-from .store import CheckpointStore
+from .store import CheckpointStore, CorruptCheckpointError
 
-__all__ = ["CheckpointCoordinator", "CheckpointStore"]
+__all__ = ["CheckpointCoordinator", "CheckpointStore",
+           "CorruptCheckpointError"]

@@ -19,6 +19,7 @@ sees fully-acked checkpoints, which is the correctness contract.
 from __future__ import annotations
 
 import os
+import shutil
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
@@ -92,6 +93,9 @@ class CheckpointCoordinator:
         # forever when a worker never acks
         self.epoch_timeout_s = env_ckpt_timeout()
         self.failed_epochs = 0
+        # epochs failed by an OSError while staging blobs (disk full,
+        # permission loss): the epoch dies loudly, the worker survives
+        self.storage_failures = 0
         self.last_failure: Optional[str] = None
         self._failed: Dict[int, str] = {}  # cid -> failure message
         # wait_committed() sleeps here; notified on finalize and failure
@@ -189,9 +193,21 @@ class CheckpointCoordinator:
             with self._lock:
                 if ckpt_id not in self._pending:
                     return 0
-            for (op_name, idx), state in blobs.items():
-                nbytes += self.store.write_blob(ckpt_id, op_name, idx,
-                                                state)
+            try:
+                for (op_name, idx), state in blobs.items():
+                    nbytes += self.store.write_blob(ckpt_id, op_name, idx,
+                                                    state)
+            except OSError as e:
+                # disk full / write failure while staging: fail the EPOCH
+                # loudly, never the worker. Staging debris is pruned so a
+                # full disk isn't made worse; the next interval retries a
+                # fresh epoch with fresh staging.
+                shutil.rmtree(self.store._dirname(ckpt_id, staging=True),
+                              ignore_errors=True)
+                with self._lock:
+                    self._fail_epoch_storage_locked(ckpt_id, worker_name, e)
+                self._notify_aborted(ckpt_id)
+                return 0
         with self._lock:
             ent = self._pending.get(ckpt_id)
             if ent is None:
@@ -289,6 +305,26 @@ class CheckpointCoordinator:
         for old in [c for c in self._failed if c < cid - 16]:
             self._failed.pop(old, None)
         self.failed_epochs += 1
+        self.last_failure = msg
+        self._commit_cond.notify_all()
+        return msg
+
+    def _fail_epoch_storage_locked(self, cid: int, worker_name: str,
+                                   err: OSError) -> str:
+        """Drop a pending epoch whose blob staging hit an OSError (lock
+        held). Same bookkeeping as the timeout path — the epoch will
+        never finalize, abort listeners fire, and restore only ever sees
+        fully-committed checkpoints."""
+        self._pending.pop(cid, None)
+        msg = (f"checkpoint epoch {cid} aborted: storage write failure "
+               f"while worker {worker_name!r} staged its snapshot "
+               f"({type(err).__name__}: {err}) — staging debris pruned, "
+               "next interval retries")
+        self._failed[cid] = msg
+        for old in [c for c in self._failed if c < cid - 16]:
+            self._failed.pop(old, None)
+        self.failed_epochs += 1
+        self.storage_failures += 1
         self.last_failure = msg
         self._commit_cond.notify_all()
         return msg
@@ -423,5 +459,8 @@ class CheckpointCoordinator:
                 "Checkpoint_bytes_total": self.total_bytes,
                 "Checkpoint_store_dir": self.store.root,
                 "Checkpoint_failed_epochs": self.failed_epochs,
+                "Checkpoint_failures": self.failed_epochs,
+                "Checkpoint_storage_failures": self.storage_failures,
+                "Checkpoint_verify_failures": self.store.verify_failures,
                 "Checkpoint_last_failure": self.last_failure,
             }

@@ -19,26 +19,67 @@ any point leaves either the previous committed checkpoint intact or a
 ``retain`` committed checkpoints.
 
 Blob files are named ``<sanitized-op-name>_<crc32>__<replica>.blob`` (the
-crc disambiguates op names that sanitize identically); each blob pickles
-``{"op": <exact name>, "replica": idx, "state": <replica state dict>}``
-so restore matches replicas by exact name, never by file name.
+crc disambiguates op names that sanitize identically — it says nothing
+about the blob's CONTENT); each blob pickles ``{"op": <exact name>,
+"replica": idx, "state": <replica state dict>}`` so restore matches
+replicas by exact name, never by file name.
+
+Content integrity (``WF_CKPT_VERIFY``, on by default): every blob's
+sha256 digest is recorded in the manifest at snapshot time, and restore
+re-hashes each blob before unpickling it — a torn, truncated, or
+bit-flipped blob raises a typed ``CorruptCheckpointError`` naming the
+bad file instead of feeding garbage state into the graph. Manifests
+written before this scheme carry no ``digests`` map and restore with a
+warning, never an error.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
 import re
 import shutil
 import threading
+import warnings
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
+
+from ..basic import WindFlowError
 
 MANIFEST = "manifest.json"
 FORMAT_VERSION = 1
 
 _CKPT_RE = re.compile(r"^ckpt_(\d{10})$")
+
+
+class CorruptCheckpointError(WindFlowError):
+    """A checkpoint failed content verification: a blob's sha256 digest
+    does not match the manifest, a manifested blob is missing, the
+    manifest itself is undecodable, or a blob cannot be unpickled. The
+    message names the bad file. The supervisor's fallback ladder catches
+    this and walks to the next-older checkpoint."""
+
+
+def env_ckpt_verify() -> bool:
+    """``WF_CKPT_VERIFY``: write blob digests into manifests and verify
+    them on restore. On by default; 0/false/off disables both sides
+    (the microbench A/B knob — and an escape hatch, not a config)."""
+    v = os.environ.get("WF_CKPT_VERIFY", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def _hash_bytes(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return "sha256:" + h.hexdigest()
 
 
 def blob_name(op_name: str, replica_idx: int) -> str:
@@ -78,6 +119,15 @@ class CheckpointStore:
         self.root = root
         self.retain = max(1, int(retain))
         os.makedirs(root, exist_ok=True)
+        # digests of staged blobs, keyed ckpt_id -> {fname: "sha256:..."}
+        # — hashed from the in-memory payload at write time (free second
+        # read avoided); commit() folds them into the manifest
+        self._digests: Dict[int, Dict[str, str]] = {}
+        self._digest_lock = threading.Lock()
+        # cumulative digest-verification failures observed by THIS store
+        # instance (surfaced as Checkpoint_verify_failures /
+        # windflow_ckpt_verify_failures_total)
+        self.verify_failures = 0
 
     # -- paths -------------------------------------------------------------
     def _dirname(self, ckpt_id: int, staging: bool = False) -> str:
@@ -90,6 +140,8 @@ class CheckpointStore:
         staging = self._dirname(ckpt_id, staging=True)
         shutil.rmtree(staging, ignore_errors=True)
         os.makedirs(staging, exist_ok=True)
+        with self._digest_lock:
+            self._digests.pop(ckpt_id, None)
 
     # -- writes ------------------------------------------------------------
     def write_blob(self, ckpt_id: int, op_name: str, replica_idx: int,
@@ -101,8 +153,12 @@ class CheckpointStore:
         payload = pickle.dumps(
             {"op": op_name, "replica": replica_idx, "state": state},
             protocol=pickle.HIGHEST_PROTOCOL)
-        _atomic_write(os.path.join(staging,
-                                   blob_name(op_name, replica_idx)), payload)
+        fname = blob_name(op_name, replica_idx)
+        if env_ckpt_verify():
+            digest = _hash_bytes(payload)
+            with self._digest_lock:
+                self._digests.setdefault(ckpt_id, {})[fname] = digest
+        _atomic_write(os.path.join(staging, fname), payload)
         return len(payload)
 
     def staged_blobs(self, ckpt_id: int) -> List[str]:
@@ -122,6 +178,15 @@ class CheckpointStore:
         manifest.setdefault("format", FORMAT_VERSION)
         manifest["ckpt_id"] = ckpt_id
         manifest["blobs"] = self.staged_blobs(ckpt_id)
+        with self._digest_lock:
+            cached = self._digests.pop(ckpt_id, {})
+        if env_ckpt_verify():
+            # blobs written through another store instance (or with the
+            # knob off at write time) aren't in the cache: hash the file
+            manifest["digests"] = {
+                fname: cached.get(fname)
+                or _hash_file(os.path.join(staging, fname))
+                for fname in manifest["blobs"]}
         _atomic_write(os.path.join(staging, MANIFEST),
                       json.dumps(manifest, indent=1).encode())
         shutil.rmtree(final, ignore_errors=True)  # same-id re-commit
@@ -177,8 +242,18 @@ class CheckpointStore:
 
     @staticmethod
     def load_manifest(ckpt_dir: str) -> Dict[str, Any]:
-        with open(os.path.join(ckpt_dir, MANIFEST)) as f:
-            return json.load(f)
+        path = os.path.join(ckpt_dir, MANIFEST)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise
+        except (ValueError, UnicodeDecodeError, OSError) as e:
+            # a torn/garbled manifest is corruption, not a crash: typed
+            # so the supervisor's fallback ladder can walk past it
+            raise CorruptCheckpointError(
+                f"checkpoint manifest {path}: undecodable "
+                f"({type(e).__name__}: {e})") from e
 
     @staticmethod
     def load_blob(ckpt_dir: str, fname: str) -> Dict[str, Any]:
@@ -211,11 +286,116 @@ class CheckpointStore:
         The whole read holds the checkpoint root's store lock, excluding
         a concurrent ``prune`` (a live coordinator committing into the
         same root) for the duration — the blobs named by the manifest
-        cannot vanish halfway through the restore."""
+        cannot vanish halfway through the restore.
+
+        With ``WF_CKPT_VERIFY`` on (default), each blob is re-hashed
+        against the manifest's digest BEFORE unpickling; any mismatch,
+        missing blob, or undecodable pickle raises
+        ``CorruptCheckpointError`` naming the bad file. Pre-digest
+        manifests (no ``digests`` map) restore with a warning."""
+        verify = env_ckpt_verify()
+        digests = manifest.get("digests") or {}
+        blobs = manifest.get("blobs", [])
+        if verify and blobs and not digests:
+            warnings.warn(
+                f"checkpoint {ckpt_dir} carries no content digests "
+                "(written before integrity verification, or with "
+                "WF_CKPT_VERIFY=0): restoring unverified",
+                RuntimeWarning, stacklevel=2)
         out: Dict[Tuple[str, int], Any] = {}
         with self._lock_of(os.path.dirname(os.path.abspath(ckpt_dir))
                            or self.root):
-            for fname in manifest.get("blobs", []):
-                blob = self.load_blob(ckpt_dir, fname)
+            for fname in blobs:
+                path = os.path.join(ckpt_dir, fname)
+                want = digests.get(fname) if verify else None
+                if want is not None:
+                    try:
+                        got = _hash_file(path)
+                    except OSError as e:
+                        self.verify_failures += 1
+                        raise CorruptCheckpointError(
+                            f"checkpoint blob {path}: unreadable "
+                            f"({type(e).__name__}: {e})") from e
+                    if got != want:
+                        self.verify_failures += 1
+                        raise CorruptCheckpointError(
+                            f"checkpoint blob {path}: content digest "
+                            f"mismatch (manifest {want}, file {got}) — "
+                            "the blob is torn or corrupted on disk")
+                try:
+                    blob = self.load_blob(ckpt_dir, fname)
+                except CorruptCheckpointError:
+                    raise
+                except Exception as e:
+                    # digest matched (or verification off) yet the pickle
+                    # is undecodable / the file vanished: still corruption
+                    self.verify_failures += 1
+                    raise CorruptCheckpointError(
+                        f"checkpoint blob {path}: undecodable "
+                        f"({type(e).__name__}: {e})") from e
                 out[(blob["op"], int(blob["replica"]))] = blob["state"]
         return out
+
+    # -- integrity ---------------------------------------------------------
+    def verify(self, ckpt_id: Optional[int] = None) -> Dict[int, Dict[str, Any]]:
+        """Offline integrity sweep: re-hash every blob of one (or every)
+        committed checkpoint against its manifest, WITHOUT unpickling
+        anything. Returns ``{ckpt_id: {"ok", "problems", "blobs",
+        "bytes", "digested"}}`` — never raises on corruption, so an
+        operator can survey a damaged store in one call."""
+        ids = [ckpt_id] if ckpt_id is not None else self.completed_ids()
+        report: Dict[int, Dict[str, Any]] = {}
+        with self._lock_of(self.root):
+            for cid in ids:
+                d = self._dirname(cid)
+                problems: List[str] = []
+                nbytes = 0
+                digested = False
+                try:
+                    manifest = self.load_manifest(d)
+                except (FileNotFoundError, CorruptCheckpointError) as e:
+                    report[cid] = {"ok": False, "problems": [str(e)],
+                                   "blobs": 0, "bytes": 0,
+                                   "digested": False}
+                    continue
+                digests = manifest.get("digests") or {}
+                digested = bool(digests)
+                for fname in manifest.get("blobs", []):
+                    path = os.path.join(d, fname)
+                    try:
+                        nbytes += os.path.getsize(path)
+                        got = _hash_file(path)
+                    except OSError as e:
+                        problems.append(f"{fname}: unreadable "
+                                        f"({type(e).__name__}: {e})")
+                        continue
+                    want = digests.get(fname)
+                    if want is not None and got != want:
+                        problems.append(f"{fname}: digest mismatch "
+                                        f"(manifest {want}, file {got})")
+                report[cid] = {"ok": not problems, "problems": problems,
+                               "blobs": len(manifest.get("blobs", [])),
+                               "bytes": nbytes, "digested": digested}
+        return report
+
+    def quarantine(self, ckpt_id: int) -> Optional[str]:
+        """Move a corrupt committed checkpoint out of the restore set by
+        renaming ``ckpt_N`` to ``ckpt_N.corrupt`` (no longer matches the
+        checkpoint name pattern, so ``completed_ids``/``latest`` skip
+        it). The data is kept for post-mortem — an operator can rename
+        it back after repairing the blob. Returns the quarantine path,
+        or None when the directory is already gone."""
+        with self._lock_of(self.root):
+            d = self._dirname(ckpt_id)
+            if not os.path.isdir(d):
+                return None
+            dst = d + ".corrupt"
+            shutil.rmtree(dst, ignore_errors=True)
+            try:
+                os.replace(d, dst)
+            except OSError:
+                # rename failed (exotic filesystem): deleting is the
+                # only way to guarantee the ladder never retries it
+                shutil.rmtree(d, ignore_errors=True)
+                return None
+            return dst

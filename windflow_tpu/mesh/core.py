@@ -28,10 +28,48 @@ program runs unchanged on a real multi-chip TPU slice.
 from __future__ import annotations
 
 import math
+import threading
 
 import numpy as np
 
 from ..tpu.schema import broadcast_scalar_fields
+
+# -- device-health exclusion registry ---------------------------------------
+# Device ids the supervision plane has marked lost (health probe,
+# supervision/health.py). Every mesh built through make_key_mesh avoids
+# them, so a supervised rebuild after device loss lands the sharded state
+# on the surviving devices. Process-global on purpose: a lost chip is
+# lost for every graph in the process.
+_EXCLUDED_DEVICE_IDS: frozenset = frozenset()
+_EXCLUDE_LOCK = threading.Lock()
+
+
+def set_excluded_devices(device_ids) -> None:
+    """Replace the excluded-device set (ids as in ``device.id``). The
+    supervisor calls this from the health probe before every rebuild;
+    an empty set restores full capacity."""
+    global _EXCLUDED_DEVICE_IDS
+    with _EXCLUDE_LOCK:
+        _EXCLUDED_DEVICE_IDS = frozenset(int(d) for d in device_ids)
+
+
+def excluded_device_ids() -> frozenset:
+    return _EXCLUDED_DEVICE_IDS
+
+
+def healthy_devices():
+    """``jax.devices()`` minus the excluded set. Falls back to ALL
+    devices when the exclusion set would leave nothing — a probe gone
+    mad must degrade to the pre-probe behavior, not to a zero-device
+    mesh."""
+    import jax
+
+    devs = jax.devices()
+    excl = _EXCLUDED_DEVICE_IDS
+    if not excl:
+        return list(devs)
+    alive = [d for d in devs if d.id not in excl]
+    return alive if alive else list(devs)
 
 
 def wf_shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -87,14 +125,21 @@ def make_key_mesh(n_devices: int, shape=None):
     import jax
     from jax.sharding import Mesh
 
+    alive = healthy_devices()
     if shape is not None:
         ka, da = shape
         if ka * da > len(jax.devices()):
             raise ValueError(f"mesh shape {shape} needs {ka * da} devices, "
                              f"have {len(jax.devices())}")
-        arr = np.array(jax.devices()[:ka * da]).reshape(ka, da)
+        if ka * da > len(alive):
+            # the forced factorization no longer fits the surviving
+            # devices (health exclusions): degrade to the auto path over
+            # what is healthy rather than refusing to recover
+            return make_key_mesh(len(alive))
+        arr = np.array(alive[:ka * da]).reshape(ka, da)
         return Mesh(arr, ("key", "data"))
-    devs = jax.devices()[:n_devices]
+    n_devices = max(1, min(int(n_devices), len(alive)))
+    devs = alive[:n_devices]
     ka = n_devices
     da = 1
     # prefer a 2D mesh when the device count allows it

@@ -226,6 +226,12 @@ class FfatMeshReplica(TPUReplicaBase):
         self._state = init_fn(sample)
         self._sharding = NamedSharding(self._mesh, P(("key", "data")))
         self.stats.mesh_devices = ka * da
+        from .core import excluded_device_ids
+        if excluded_device_ids():
+            want = min(n_dev, len(jax.devices()))
+            self.stats.mesh_degraded = max(0, want - ka * da)
+        else:
+            self.stats.mesh_degraded = 0
         if pend is not None:
             self._apply_pending_restore()
 

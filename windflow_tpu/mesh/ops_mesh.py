@@ -197,6 +197,7 @@ class _MeshReplicaBase(TPUReplicaBase):
         self._mesh = make_key_mesh(n_dev, shape=op.mesh_shape)
         ns = mesh_shard_count(self._mesh)
         self._ns = ns
+        self._note_degraded(n_dev, ns)
         self._local_batch = op.local_batch or max(1, math.ceil(cap / ns))
         self._GB = ns * self._local_batch
         self._K_pad = math.ceil(op.key_capacity / ns) * ns
@@ -210,6 +211,32 @@ class _MeshReplicaBase(TPUReplicaBase):
             dt.itemsize for dt in self._val_dtypes.values()))
         self.stats.mesh_devices = ns
         self._after_mesh_ensure()
+
+    def _note_degraded(self, requested: int, ns: int) -> None:
+        """Degraded-capacity report: the mesh came up on fewer devices
+        than the op would otherwise use because the supervision plane
+        excluded lost devices (mesh/core registry). Surfaced per-replica
+        as ``Mesh_degraded_devices`` plus a ``mesh:degrade`` flight span;
+        the supervisor aggregates it into ``Recovery_degraded_devices``
+        and the overload governor jumps straight to SHED while > 0."""
+        import jax
+
+        from .core import excluded_device_ids
+
+        excl = excluded_device_ids()
+        if not excl:
+            self.stats.mesh_degraded = 0
+            return
+        want = min(int(requested), len(jax.devices()))
+        degraded = max(0, want - int(ns))
+        self.stats.mesh_degraded = degraded
+        if degraded:
+            from ..monitoring.flightrec import thread_recorder
+            rec = thread_recorder()
+            if rec is not None:
+                rec.event("mesh:degrade", 0.0, {
+                    "op": self.op.name, "devices": ns,
+                    "excluded": sorted(excl), "requested": want})
 
     def _after_mesh_ensure(self) -> None:
         raise NotImplementedError

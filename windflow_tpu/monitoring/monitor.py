@@ -242,6 +242,9 @@ _PROM_SCALARS = (
     ("windflow_mesh_shard_skew", "gauge",
      "Max/mean shard occupancy (1.0 = even key spread)",
      "Mesh_shard_skew", 1),
+    ("windflow_mesh_degraded_devices", "gauge",
+     "Devices this mesh replica runs WITHOUT (device-loss failover)",
+     "Mesh_degraded_devices", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -316,6 +319,31 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                      "checkpoints committed by the coordinator")
         lines.append("# TYPE windflow_checkpoints_completed_total counter")
         lines.extend(ckpt_body)
+    # checkpoint integrity + storage hardening (durable-recovery plane)
+    _CKPT_FAMS = (
+        ("windflow_ckpt_verify_failures_total", "counter",
+         "Checkpoint blobs that failed sha256 verification on restore",
+         "Checkpoint_verify_failures", 1),
+        ("windflow_ckpt_failures_total", "counter",
+         "Checkpoint epochs failed (timeout or storage write error)",
+         "Checkpoint_failures", 1),
+        ("windflow_ckpt_storage_failures_total", "counter",
+         "Checkpoint epochs aborted by an OSError while staging blobs",
+         "Checkpoint_storage_failures", 1),
+    )
+    for fam, typ, help_, field, scale in _CKPT_FAMS:
+        body = []
+        for graph, st in reports.items():
+            if not isinstance(st, dict):
+                continue
+            v = (st.get("Checkpoints") or {}).get(field)
+            if isinstance(v, (int, float)):
+                body.append(f'{fam}{{graph="{_prom_escape(graph)}"}} '
+                            f'{v * scale:g}')
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
     # elastic rescaling (windflow_tpu.scaling): per-operator parallelism
     # gauge + per-graph rescale counters/timings so a scaling event is a
     # first-class Prometheus signal
@@ -380,6 +408,20 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
         ("windflow_restart_seconds_total", "counter",
          "Cumulative detect->resume time across supervised restarts",
          "Supervision_restart_total_s", 1),
+        # durable-recovery plane: fallback-ladder + device-loss signals
+        ("windflow_recovery_ladder_depth", "gauge",
+         "Checkpoint rungs skipped by the last supervised restore "
+         "(0 = latest restored cleanly)", "Recovery_ladder_depth", 1),
+        ("windflow_recovery_verify_failures_total", "counter",
+         "Corrupt/unusable checkpoint rungs walked past by the "
+         "fallback-ladder restore", "Recovery_verify_failures", 1),
+        ("windflow_recovery_degraded_devices", "gauge",
+         "Mesh devices currently excluded by the device-health probe "
+         "(degraded capacity; 0 = full shape)",
+         "Recovery_degraded_devices", 1),
+        ("windflow_recovery_planned_restarts_total", "counter",
+         "Planned supervised restarts (mesh re-expansion after a device "
+         "returned)", "Supervision_planned_restarts", 1),
     )
     for fam, typ, help_, field, scale in _SUPERVISE_FAMS:
         body = []

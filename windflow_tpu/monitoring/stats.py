@@ -74,6 +74,10 @@ class StatsRecord:
         # mesh exists
         "mesh_devices", "mesh_steps", "mesh_shuffle_bytes",
         "mesh_step_total_us", "mesh_shard_occupancy", "mesh_shard_skew",
+        # devices this mesh replica is running WITHOUT because the
+        # supervision plane excluded them (device-loss failover): > 0
+        # means degraded capacity until the probe sees them return
+        "mesh_degraded",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -160,6 +164,7 @@ class StatsRecord:
         self.mesh_step_total_us = 0.0
         self.mesh_shard_occupancy = 0
         self.mesh_shard_skew = 0.0
+        self.mesh_degraded = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -433,6 +438,7 @@ class StatsRecord:
             d["Mesh_step_usec_total"] = round(self.mesh_step_total_us, 1)
             d["Mesh_shard_occupancy"] = self.mesh_shard_occupancy
             d["Mesh_shard_skew"] = self.mesh_shard_skew
+            d["Mesh_degraded_devices"] = self.mesh_degraded
         # -- queue / backpressure plane (0s for sources and fused chains) ---
         ch = self.input_channel
         d["Queue_len"] = len(ch) if ch is not None else 0

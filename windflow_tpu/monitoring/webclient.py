@@ -17,6 +17,8 @@ dependency-free client served by ``MonitoringServer.serve_http``: it polls
   ``Rescale_events`` advanced) plus a rescale badge with the last
   operator/parallelism/pause — the per-operator ``par`` column is live,
   so a scaling action is visible the moment it lands,
+- a degraded badge while the recovery plane runs with excluded devices
+  (device-loss failover), with the last restore's ladder depth,
 - the dataflow SVG diagram (server-sanitized),
 - per-replica drill-down on click.
 """
@@ -163,6 +165,14 @@ function render(snap){
       (rst ? ` (MTTR ${fmt((sv.Supervision_last_restart_s||0)*1e3)}ms)`
            : "")+
       (sv.Supervision_escalated ? " — escalated" : "")+`</span>`;
+  // degraded-mesh badge: devices the recovery plane excluded after a
+  // device loss; warn style until the probe sees them return and a
+  // planned restart re-expands the mesh to full shape
+  const dg = sv.Recovery_degraded_devices|0;
+  if (dg) el("badges").innerHTML +=
+    `<span class="badge warn">degraded: ${dg} device(s) excluded`+
+    ((sv.Recovery_ladder_depth|0) ?
+      ` · ladder depth ${sv.Recovery_ladder_depth|0}` : "")+`</span>`;
   const dlq = st.Dead_letters|0;
   if (dlq) el("badges").innerHTML +=
     `<span class="badge warn">dead letters ${fmt(dlq)}</span>`;

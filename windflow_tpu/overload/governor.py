@@ -421,9 +421,33 @@ class OverloadGovernor(threading.Thread):
                    {"state": SLO_STATES[self.policy.rung],
                     "p99_us": round(p99 or 0.0, 1), "detail": detail})
 
+    def _degraded_devices(self) -> int:
+        """Devices the supervision plane is currently running WITHOUT
+        (device-loss failover): read from the graph's supervisor. While
+        > 0 the graph's capacity is physically reduced — TUNE and SCALE
+        cannot buy it back (mesh ops refuse to rescale, and the missing
+        chip is the bottleneck), so escalation jumps straight to SHED."""
+        sup = getattr(self.graph, "_supervisor", None)
+        return int(getattr(sup, "degraded_devices", 0) or 0) \
+            if sup is not None else 0
+
     # -- escalation ladder -------------------------------------------------
     def _escalate(self, now: float, p99: Optional[float]) -> None:
         pol = self.policy
+        degraded = self._degraded_devices()
+        if degraded > 0:
+            # degraded mesh capacity: shed immediately instead of
+            # silently overloading the surviving devices
+            try:
+                self._engage_shed()
+            except WindFlowError as e:
+                self.last_error = f"shed rung (degraded): {e}"
+                return
+            pol.note_action(now, SHED)
+            self.escalations += 1
+            self._note("escalate", now, p99,
+                       f"shed (mesh degraded by {degraded} device(s))")
+            return
         if pol.rung < TUNE and self._try_tune():
             pol.note_action(now, TUNE)
             self.escalations += 1
@@ -648,5 +672,6 @@ class OverloadGovernor(threading.Thread):
             "Overload_shed_bytes": shed_bytes,
             "Overload_errors": self.errors,
             "Overload_last_error": self.last_error,
+            "Overload_degraded_devices": self._degraded_devices(),
             "Overload_history": list(self.history),
         }

@@ -20,8 +20,12 @@ Two planes close the loop between "fault-tolerant" and "self-healing":
 """
 
 from .errors import DeadLetterQueue, ErrorPolicy
+from .health import (DeviceHealthProbe, JaxDeviceProbe, StaticDeviceProbe,
+                     failure_domain_map)
 from .policy import RestartPolicy
 from .supervisor import SupervisionEscalated, Supervisor
 
 __all__ = ["RestartPolicy", "ErrorPolicy", "DeadLetterQueue",
-           "Supervisor", "SupervisionEscalated"]
+           "Supervisor", "SupervisionEscalated",
+           "DeviceHealthProbe", "JaxDeviceProbe", "StaticDeviceProbe",
+           "failure_domain_map"]

@@ -1,0 +1,124 @@
+"""Device-health probing and failure-domain mapping (device-loss plane).
+
+DrJAX-style sharded execution (PAPERS.md, arXiv:2403.07128) makes the
+single device the natural failure domain of the mesh plane: a lost chip
+takes out exactly the mesh shards placed on it, nothing else. This
+module supplies the two pieces the supervisor needs to act on that:
+
+- a pluggable :class:`DeviceHealthProbe` answering "which device ids are
+  dead right now?" — the default :class:`JaxDeviceProbe` runs a tiny
+  device_put per device (an unreachable chip raises); tests and the
+  chaos harness inject a :class:`StaticDeviceProbe` with a mutable dead
+  set to simulate loss and return;
+- :func:`failure_domain_map`: device id -> the mesh operators whose
+  sharded state lives on it, read from the built replicas' meshes —
+  what the ``mesh:degrade`` span reports so an operator knows WHAT a
+  dead chip takes down.
+
+The supervisor consults the probe before every rebuild and publishes
+the dead set into the mesh-core exclusion registry
+(``mesh.core.set_excluded_devices``): the rebuilt mesh ops come up on
+the surviving devices, restoring their sharded state through the
+existing slot-row-gather relayout (byte-identical keyed results — only
+padding rows move). While any device is excluded the graph runs
+degraded (``Recovery_degraded_devices`` > 0, overload governor sheds
+instead of scaling); when the probe sees the device return, the
+supervisor performs one planned restart to re-expand to full shape.
+
+``WF_HEALTH_PROBE=jax`` installs the default probe on supervised graphs
+without code changes; ``PipeGraph.with_device_probe`` installs any
+probe explicitly. ``WF_HEALTH_PROBE_INTERVAL`` (seconds) paces the
+recovery polling.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, FrozenSet, Iterable, List
+
+__all__ = ["DeviceHealthProbe", "JaxDeviceProbe", "StaticDeviceProbe",
+           "failure_domain_map", "probe_from_env"]
+
+
+class DeviceHealthProbe:
+    """Answers which accelerator device ids are currently dead. The
+    supervisor calls :meth:`dead_devices` before every rebuild and every
+    ``interval_s`` while the graph runs degraded (re-expansion poll).
+    Implementations must be cheap and must never raise for a healthy
+    system — a probe exception is treated as "no new information"."""
+
+    interval_s: float = 1.0
+
+    def dead_devices(self) -> FrozenSet[int]:
+        raise NotImplementedError
+
+
+class JaxDeviceProbe(DeviceHealthProbe):
+    """Default probe: a scalar ``device_put`` + ``block_until_ready``
+    per device — an unreachable/failed chip raises, a healthy one costs
+    microseconds. Suitable for the virtual CPU mesh and real TPU
+    slices alike."""
+
+    def __init__(self, interval_s: float = 1.0) -> None:
+        self.interval_s = float(interval_s)
+
+    def dead_devices(self) -> FrozenSet[int]:
+        import jax
+        import jax.numpy as jnp
+
+        dead = set()
+        for d in jax.devices():
+            try:
+                jax.device_put(jnp.zeros((), jnp.int32), d) \
+                    .block_until_ready()
+            except Exception:
+                dead.add(int(d.id))
+        return frozenset(dead)
+
+
+class StaticDeviceProbe(DeviceHealthProbe):
+    """Test/chaos probe: reports exactly the mutable ``dead`` set, so a
+    harness can simulate device loss (``probe.dead.add(7)``) and return
+    (``probe.dead.clear()``) without touching jax at all."""
+
+    def __init__(self, dead: Iterable[int] = (),
+                 interval_s: float = 0.05) -> None:
+        self.dead = set(int(d) for d in dead)
+        self.interval_s = float(interval_s)
+
+    def dead_devices(self) -> FrozenSet[int]:
+        return frozenset(self.dead)
+
+
+def probe_from_env() -> "DeviceHealthProbe | None":
+    """``WF_HEALTH_PROBE=jax`` -> a :class:`JaxDeviceProbe` (paced by
+    ``WF_HEALTH_PROBE_INTERVAL`` seconds, default 1.0); unset/other ->
+    None (no probing — device loss then surfaces as worker crashes
+    only, recovered without exclusions)."""
+    kind = os.environ.get("WF_HEALTH_PROBE", "").strip().lower()
+    if kind != "jax":
+        return None
+    try:
+        interval = float(os.environ.get("WF_HEALTH_PROBE_INTERVAL",
+                                        "1.0") or 1.0)
+    except ValueError:
+        interval = 1.0
+    return JaxDeviceProbe(interval_s=max(0.01, interval))
+
+
+def failure_domain_map(graph) -> Dict[int, List[str]]:
+    """Device id -> sorted mesh-operator names whose device mesh places
+    shards on it, read from the BUILT replicas (empty before the lazy
+    mesh construction ran). Non-mesh operators have no entry: their
+    failure domain is the host, not a chip."""
+    import numpy as np
+
+    out: Dict[int, set] = {}
+    for op in getattr(graph, "_ops", []):
+        for r in op.replicas:
+            mesh = getattr(r, "_mesh", None)
+            if mesh is None:
+                continue
+            for d in np.ravel(mesh.devices):
+                out.setdefault(int(d.id), set()).add(op.name)
+    return {dev: sorted(names) for dev, names in sorted(out.items())}

@@ -20,11 +20,22 @@ The supervisor closes the loop with the machinery previous PRs built:
    killed; its next channel touch raises the teardown signal, and
    exactly-once sinks fence its zombie writes);
 4. **restore** — rebuild the runtime plane from the stage IR
-   (``PipeGraph._rebuild_runtime``, the rescale path) and push the
-   latest COMMITTED checkpoint's blobs back in: sources resume from
-   their recorded positions, exactly-once sinks roll staged epochs
-   forward/abort per the 2PC recovery contract — restarts are
-   duplicate-free out of the box;
+   (``PipeGraph._rebuild_runtime``, the rescale path) and push a
+   COMMITTED checkpoint's blobs back in, walking a FALLBACK LADDER from
+   the latest across the retain-K window: a checkpoint that fails
+   content verification (``CorruptCheckpointError``) or blows up
+   mid-apply is quarantined (``ckpt_N`` -> ``ckpt_N.corrupt``) and the
+   next-older one is tried, down to captured-initial full replay as the
+   last rung — a corrupt latest checkpoint degrades MTTR, never
+   correctness. Restoring epoch N-1 carries ``txn_last_epoch = N-1``,
+   so exactly-once sinks abort every pending epoch > N-1 on restore and
+   the roll-forward cannot duplicate; sources rewind to the older
+   positions with the same blobs. When a device-health probe is wired
+   (``with_device_probe`` / ``WF_HEALTH_PROBE``), dead devices are
+   excluded from the rebuilt meshes first: mesh ops come back on the
+   surviving devices (state relayouts byte-identically), the graph runs
+   degraded until the probe sees the device return, then ONE planned
+   restart re-expands to full shape;
 5. **resume** — fresh workers start; cumulative crash/DLQ counters are
    carried over so dashboards do not zero out after recovery. The
    detect→resume time is the per-event MTTR
@@ -77,6 +88,13 @@ class Supervisor(threading.Thread):
         self.last_cause = ""
         self.abandoned: List[str] = []  # wedged worker threads left behind
         self.history: List[Dict[str, Any]] = []  # bounded, newest last
+        # durable-recovery plane: fallback-ladder + device-loss state
+        self.last_ladder_depth = 0   # rungs skipped by the last restore
+        self.verify_failures = 0     # cumulative corrupt rungs walked past
+        self.degraded_devices = 0    # devices currently excluded
+        self.planned_restarts = 0    # re-expansion restarts (not failures)
+        self._excluded: frozenset = frozenset()
+        self._next_probe_t = 0.0
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._stall_seen = 0  # consumed prefix of watchdog.fired
@@ -128,6 +146,15 @@ class Supervisor(threading.Thread):
                                           f"{type(e).__name__}: {e}",
                                    cause=e)
                 if not self.active:
+                    return
+            elif self.active:
+                try:
+                    self._maybe_reexpand()
+                except Exception as e:
+                    self._escalate([], [],
+                                   reason=f"mesh re-expansion failed: "
+                                          f"{type(e).__name__}: {e}",
+                                   cause=e)
                     return
 
     def _new_stalls(self) -> List[str]:
@@ -238,43 +265,177 @@ class Supervisor(threading.Thread):
             self._span("supervise:abandon", 0.0, wedged)
 
     def _rebuild_and_restore(self) -> Optional[int]:
-        """Rebuild the runtime plane and push the latest committed
-        checkpoint back in. Returns the restored checkpoint id (None
-        when no checkpoint has committed yet)."""
+        """Rebuild the runtime plane and push a committed checkpoint
+        back in, walking the fallback ladder newest -> oldest when a
+        rung fails verification or mid-apply. Returns the restored
+        checkpoint id (None for the full-replay rung)."""
         g = self.graph
         coord = g._coordinator
         carry = self._collect_carryover()
+        # device health first: the rebuilt meshes must avoid dead chips
+        self._apply_device_exclusions()
         g._rebuild_runtime()
         cid = None
         if coord is not None:
-            cid = coord.store.latest()
-            if cid is None:
-                # no checkpoint has COMMITTED yet: resuming from the
-                # sources' in-memory cursors would silently drop every
-                # record that sat in the discarded channels — reset
-                # replayable sources to their captured INITIAL positions
-                # instead (full replay; exactly-once sinks have
-                # committed nothing, so the replay is duplicate-free)
-                self._reset_sources_to_initial()
-            else:
-                ckpt_dir = coord.store._dirname(cid)
-                manifest = coord.store.load_manifest(ckpt_dir)
-                g._restore_states(
-                    coord.store.load_states(ckpt_dir, manifest))
-                # new epochs continue after the restored one; rebuilt
-                # sources anchor their barrier cursor to requested_id
-                # at Worker construction, which _rebuild_runtime already
-                # ran — keep the ids monotone for the next trigger
-                with coord._lock:
-                    coord._alloc_id = max(coord._alloc_id, cid)
-                    if coord.requested_id < cid:
-                        coord.requested_id = cid
-                    coord.last_completed_id = max(
-                        coord.last_completed_id, cid)
+            cid = self._restore_ladder(coord)
             coord.expected_acks = len(g._workers)
             coord.worker_names = [w.name for w in g._workers]
         self._apply_carryover(carry)
         return cid
+
+    def _restore_ladder(self, coord) -> Optional[int]:
+        """Walk committed checkpoints newest -> oldest until one both
+        verifies and applies. A failing rung is quarantined
+        (``ckpt_N.corrupt`` — kept for post-mortem, invisible to
+        restore) and the partially-applied plane is rebuilt clean before
+        the next rung. Exhausting the ladder falls back to
+        captured-initial full replay: exactly-once sinks abort every
+        pre-committed epoch on the way down (the restored
+        ``txn_last_epoch`` / the full-replay reset), so no rung can
+        duplicate records."""
+        g = self.graph
+        store = coord.store
+        depth = 0
+        for cid in reversed(store.completed_ids()):
+            try:
+                ckpt_dir = store._dirname(cid)
+                manifest = store.load_manifest(ckpt_dir)
+                states = store.load_states(ckpt_dir, manifest)
+                # epoch ids roll back to the restored rung BEFORE the
+                # rebuild, exactly like restore_from=: re-created
+                # sources anchor their injection cursor here, so a
+                # replayed barrier re-uses the old epoch id and the
+                # exactly-once sinks' idempotent commit discards it —
+                # this is what keeps a ladder rung below the pre-crash
+                # latest from duplicating the already-committed epochs
+                with coord._lock:
+                    coord._alloc_id = cid
+                    coord.requested_id = cid
+                    coord.last_completed_id = cid
+                g._rebuild_runtime()
+                g._restore_states(states)
+            except Exception as e:
+                # CorruptCheckpointError from verification, or any
+                # mid-apply explosion: this rung is unusable. The dirty
+                # plane (if apply got that far) is discarded by the next
+                # rung's / the full-replay rung's rebuild.
+                depth += 1
+                self.verify_failures += 1
+                self._span("recover:verify", 0.0, {
+                    "ckpt_id": cid,
+                    "error": f"{type(e).__name__}: {e}"})
+                quarantined = store.quarantine(cid)
+                self._span("recover:fallback", 0.0, {
+                    "ckpt_id": cid, "quarantined": quarantined,
+                    "next": "older checkpoint"})
+                continue
+            self.last_ladder_depth = depth
+            return cid
+        # no (usable) checkpoint: resuming from the sources' in-memory
+        # cursors would silently drop every record that sat in the
+        # discarded channels — reset replayable sources to their
+        # captured INITIAL positions instead (full replay from epoch 0;
+        # exactly-once sinks discard replayed epochs that already
+        # committed and abort stale pre-committed ones, so the replay
+        # is duplicate-free)
+        with coord._lock:
+            coord._alloc_id = 0
+            coord.requested_id = 0
+            coord.last_completed_id = 0
+        g._rebuild_runtime()
+        self._reset_sources_to_initial()
+        if depth:
+            self._span("recover:fallback", 0.0, {
+                "ckpt_id": None, "next": "full replay",
+                "rungs_failed": depth})
+        self.last_ladder_depth = depth
+        return None
+
+    # -- device-loss failover (supervision/health.py) ----------------------
+    def _apply_device_exclusions(self) -> None:
+        """Consult the graph's device-health probe (when wired) and
+        publish dead devices into the mesh-core exclusion registry, so
+        the rebuild lands mesh state on surviving devices only. Runs
+        BEFORE ``_rebuild_runtime``. A probe exception keeps the
+        previous exclusion set — no new information must never block a
+        recovery."""
+        g = self.graph
+        probe = getattr(g, "_device_probe", None)
+        if probe is None:
+            return
+        try:
+            dead = frozenset(int(d) for d in probe.dead_devices())
+        except Exception:
+            dead = self._excluded
+        from ..mesh.core import set_excluded_devices
+        if dead != self._excluded:
+            set_excluded_devices(dead)
+            if dead:
+                try:
+                    from .health import failure_domain_map
+                    domains = {d: failure_domain_map(g).get(d, [])
+                               for d in sorted(dead)}
+                except Exception:
+                    domains = {}
+                self._span("mesh:degrade", 0.0, {
+                    "excluded": sorted(dead), "domains": domains})
+            self._excluded = dead
+        self.degraded_devices = len(dead)
+
+    def _maybe_reexpand(self) -> None:
+        """While degraded, poll the probe at its own pace; the moment an
+        excluded device reports healthy again, perform ONE planned
+        restart so the mesh re-expands to full shape (the rebuild pulls
+        the shrunken exclusion set through ``_apply_device_exclusions``
+        and the relayout restore does the rest)."""
+        g = self.graph
+        probe = getattr(g, "_device_probe", None)
+        if probe is None or not self._excluded or g._ended:
+            return
+        if all(not w.is_alive() for w in g._workers):
+            return  # the stream is finishing; nothing to re-expand for
+        now = time.monotonic()
+        if now < self._next_probe_t:
+            return
+        self._next_probe_t = now + max(
+            0.01, float(getattr(probe, "interval_s", 1.0) or 1.0))
+        try:
+            dead = frozenset(int(d) for d in probe.dead_devices())
+        except Exception:
+            return
+        recovered = sorted(self._excluded - dead)
+        if not recovered:
+            return
+        self._planned_restart(
+            f"mesh re-expansion: device(s) {recovered} recovered")
+
+    def _planned_restart(self, cause: str) -> None:
+        """A deliberate restart (re-expansion): same teardown/rebuild/
+        restore flow as ``_recover`` but no backoff and no restart-budget
+        consumption — recovering capacity must never eat the failure
+        budget."""
+        g = self.graph
+        t0 = time.monotonic()
+        g._supervising = True
+        try:
+            self.last_cause = cause
+            self._span("supervise:planned", 0.0, cause)
+            self._teardown()
+            cid = self._rebuild_and_restore()
+            for w in g._workers:
+                w.start()
+            mttr = time.monotonic() - t0
+            self.planned_restarts += 1
+            self.last_restart_s = mttr
+            self.restart_total_s += mttr
+            self.history.append({
+                "t_unix": time.time(), "cause": cause, "ckpt_id": cid,
+                "mttr_s": round(mttr, 6), "planned": True})
+            del self.history[:-64]
+            self._span("supervise:resume", mttr * 1e6,
+                       {"planned": True, "ckpt_id": cid})
+        finally:
+            g._supervising = False
 
     def _reset_sources_to_initial(self) -> None:
         initial = getattr(self.graph, "_initial_positions", None) or {}
@@ -338,5 +499,9 @@ class Supervisor(threading.Thread):
             "Supervision_abandoned_threads": list(self.abandoned),
             "Supervision_budget_remaining": max(
                 0, self.policy.max_restarts - self.policy.consecutive),
+            "Supervision_planned_restarts": self.planned_restarts,
+            "Recovery_ladder_depth": self.last_ladder_depth,
+            "Recovery_verify_failures": self.verify_failures,
+            "Recovery_degraded_devices": self.degraded_devices,
             "Supervision_history": list(self.history),
         }

@@ -90,6 +90,10 @@ class PipeGraph:
         self._supervise_policy = None
         self._supervise_enabled = env_flag("WF_SUPERVISE")
         self._supervising = False
+        # device-health probe (supervision/health.py): dead devices are
+        # excluded from rebuilt meshes during supervised recovery;
+        # with_device_probe() or WF_HEALTH_PROBE=jax
+        self._device_probe = None
         # dead-letter queue (windflow_tpu.supervision.errors): created
         # lazily when any operator carries a quarantining error policy
         self._dlq = None
@@ -312,6 +316,29 @@ class PipeGraph:
         if not self._ckpt_enabled:
             self.with_checkpointing()
         return self
+
+    def with_device_probe(self, probe: Any) -> "PipeGraph":
+        """Install a device-health probe (``supervision.health``): during
+        every supervised recovery the probe's dead devices are excluded
+        from the rebuilt device meshes, so mesh operators come back on
+        the surviving chips with their sharded state relayouted
+        byte-identically; the graph then runs degraded
+        (``Recovery_degraded_devices`` > 0, the overload governor sheds
+        instead of scaling) until the probe sees the device return and
+        one planned restart re-expands to full shape. Env twin:
+        ``WF_HEALTH_PROBE=jax`` (paced by ``WF_HEALTH_PROBE_INTERVAL``).
+        Implies supervision's value only under supervision — without a
+        supervisor the probe is never consulted."""
+        if self._started:
+            raise WindFlowError("with_device_probe after start()")
+        self._device_probe = probe
+        return self
+
+    def failure_domains(self) -> Dict[int, List[str]]:
+        """Device id -> mesh operators whose sharded state lives on it
+        (built replicas only). The unit of loss for device failover."""
+        from ..supervision.health import failure_domain_map
+        return failure_domain_map(self)
 
     def with_compile_cache(self, cache_dir: str) -> "PipeGraph":
         """Point JAX's persistent compilation cache at ``cache_dir`` so
@@ -1124,6 +1151,9 @@ class PipeGraph:
                 self.with_checkpointing()
             from ..supervision.supervisor import Supervisor
             self._supervisor = Supervisor(self, self._supervise_policy)
+            if self._device_probe is None:
+                from ..supervision.health import probe_from_env
+                self._device_probe = probe_from_env()
         # persistent compilation cache BEFORE any device program traces
         self._setup_compile_cache()
         if any(getattr(op, "is_tpu", False) for op in self._ops):
