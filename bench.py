@@ -1106,7 +1106,16 @@ def _replay_mode() -> None:
     commit latency). The runs are wall-clock rate-paced so tuple counts
     differ slightly; correctness differentials live in
     tests/test_exactly_once.py. CPU-plane by construction. Writes
-    results/replay.json."""
+    results/replay.json.
+
+    A third leg exercises the tiered keyed-state store on the device
+    plane: Zipf-1.1 keys drawn from a 10M-distinct-key space through a
+    stateful device scan whose hot tier is a FIXED device budget
+    (``with_tiering``), the cold tail host-spilled. Reports
+    ``tiered_keys_per_device_budget`` — addressable key space per
+    device-resident slot — plus the observed distinct keys and the
+    Tier_* counters. Skipped (with a note) when the device plane is
+    unavailable."""
     import shutil
     import tempfile
     import numpy as np
@@ -1252,10 +1261,68 @@ def _replay_mode() -> None:
         shutil.rmtree(txn, ignore_errors=True)
         return out, results
 
+    def run_tiered() -> dict:
+        """Zipf-1.1 traffic over a 10M-distinct-key space through a
+        tiered stateful device scan: hot_capacity is the fixed device
+        budget, every other key lives in the host cold store. The
+        heavy-tail draw means each 512-row batch touches well under
+        hot_capacity distinct keys while the run as a whole touches
+        orders of magnitude more than fit on device."""
+        key_space = int(os.environ.get("WF_REPLAY_TIER_KEYSPACE",
+                                       str(10_000_000)))
+        hot = int(os.environ.get("WF_REPLAY_TIER_HOT", "1024"))
+        n = int(os.environ.get("WF_REPLAY_TIER_TUPLES", "80000"))
+        batch = 512
+        try:
+            from windflow_tpu.tpu import Map_TPU_Builder
+        except Exception as e:  # device plane absent: report, don't fail
+            return {"skipped": f"device plane unavailable: {e}"}
+        trng = np.random.default_rng(11)
+        # zipf(1.1) is the unbounded heavy tail; fold the rare
+        # beyond-space draws back in rather than rejecting
+        keys = (trng.zipf(1.1, size=n) - 1) % key_space
+        vals = np.arange(n, dtype=np.float64)
+
+        def src(shipper):
+            for i in range(n):
+                shipper.push({"k": int(keys[i]), "v": float(vals[i])})
+
+        g = PipeGraph("replay_tiered", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(batch).build()) \
+         .add(Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_tiering(policy="lru", hot_capacity=hot)
+              .with_name("scan").build()) \
+         .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+        t0 = time.perf_counter()
+        g.run()
+        elapsed = time.perf_counter() - t0
+        rep = [o for o in g.get_stats()["Operators"]
+               if o["name"] == "scan"][0]["replicas"][0]
+        distinct = rep.get("Tier_hot_keys", 0) + rep.get("Tier_cold_keys", 0)
+        return {
+            "key_space": key_space,
+            "hot_capacity": hot,
+            "tuples": n,
+            "tuples_per_sec": round(n / elapsed, 1),
+            "distinct_keys_seen": distinct,
+            "keys_per_device_budget": round(key_space / hot, 1),
+            "tier_promotes": rep.get("Tier_promotes", 0),
+            "tier_demotes": rep.get("Tier_demotes", 0),
+            "tier_miss_rate": rep.get("Tier_miss_rate", 0.0),
+        }
+
     print("replay: at-least-once run", file=sys.stderr)
     alo, alo_res = run(False)
     print("replay: exactly-once run", file=sys.stderr)
     eo, eo_res = run(True)
+    print("replay: tiered-state run (Zipf 1.1, 10M key space)",
+          file=sys.stderr)
+    tiered = run_tiered()
     overhead = (100.0 * (1.0 - eo["tuples_per_sec"]
                          / alo["tuples_per_sec"])
                 if alo["tuples_per_sec"] else 0.0)
@@ -1268,6 +1335,9 @@ def _replay_mode() -> None:
         "ingest_tuples_per_sec": alo["ingest_tuples_per_sec"],
         "at_least_once": alo, "exactly_once": eo,
         "exactly_once_overhead_pct": round(overhead, 2),
+        "tiered": tiered,
+        "tiered_keys_per_device_budget":
+            tiered.get("keys_per_device_budget", 0.0),
     }
     os.makedirs("results", exist_ok=True)
     with open(os.path.join("results", "replay.json"), "w") as f:

@@ -58,8 +58,8 @@ STORAGE_SCENARIOS = ("storage_truncate", "storage_bitflip",
                      "storage_ladder_kill")
 
 SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
-             "supervised_kill", "overload_kill", "mesh_kill") \
-    + STORAGE_SCENARIOS + ("device_loss",)
+             "supervised_kill", "overload_kill", "mesh_kill",
+             "tiered_kill") + STORAGE_SCENARIOS + ("device_loss",)
 
 
 class InjectedCrash(Exception):
@@ -563,6 +563,132 @@ def _mesh_kill_round(rng, report, workdir) -> dict:
     return report
 
 
+def _tiered_kill_round(rng, report, workdir) -> dict:
+    """``tiered_kill``: kill a tiered-state pipeline MID-PROMOTE under
+    supervision. A replayable source feeds a tiered stateful map (hot
+    tier 8 slots, 20-key stream, so nearly every batch promotes from the
+    cold sqlite store); after the checkpoints committed, the Nth cold
+    read (``ColdStore.take_rows``) crashes the worker — the nastiest
+    point: the keymap already re-targeted slots for the batch, the cold
+    rows are half-consumed. Checks:
+
+    - the supervisor recovers in-process (one restart), BOTH tiers
+      restoring from the checkpoint (hot table + cold sqlite image);
+    - the committed exactly-once records are byte-identical to an
+      uninterrupted golden run — a lost cold row would restart some
+      key's running sum, a replayed one would double it.
+    """
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, RestartPolicy,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.sinks.transactional import read_committed_records
+    from windflow_tpu.state.tiered import ColdStore
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n, nk, hot = 1600, 20, 8
+    ckpt_at = sorted(rng.sample(range(int(n * 0.1), int(n * 0.45)), 2))
+    # every 8-tuple batch past the hot tier's first fill promotes; the
+    # crash lands on a take_rows call well after both checkpoints
+    crash_call = rng.randrange(int(n * 0.6) // 8, int(n * 0.85) // 8)
+    report.update(n=n, nk=nk, hot_capacity=hot, ckpt_at=ckpt_at,
+                  crash_call=crash_call)
+
+    def build(store, txn, src, rows, supervised):
+        g = PipeGraph("chaos_tiered", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        if supervised:
+            g.with_supervision(RestartPolicy(max_restarts=4,
+                                             backoff_s=0.02,
+                                             backoff_max_s=0.2))
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_tiering(policy="lru", hot_capacity=hot)
+              .with_name("tscan").build())
+
+        def sink(t):
+            if t is not None:
+                rows.append((int(t["k"]), float(t["v"])))
+
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(8).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk")
+                      .with_exactly_once(staging_dir=txn).build())
+        return g
+
+    def committed(txn):
+        return sorted((int(r["k"]), float(r["v"]))
+                      for r, _ in read_committed_records(
+                          os.path.join(txn, "snk_r0")))
+
+    class TieredSource(ChaosSource):
+        def __call__(self, shipper):
+            while self.pos < self.n:
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": float(v + 1)})
+                self.pos += 1
+                if self.pos in self.ckpt_at:
+                    shipper.request_checkpoint()
+
+    gold_rows = []
+    build(os.path.join(workdir, "gold_store"),
+          os.path.join(workdir, "gold_txn"), TieredSource(n, nk),
+          gold_rows, supervised=False).run()
+    golden = committed(os.path.join(workdir, "gold_txn"))
+
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    rows = []
+    g = build(store, txn, TieredSource(n, nk, ckpt_at), rows,
+              supervised=True)
+    orig_tr = ColdStore.take_rows
+    calls = [0]
+
+    def dying_tr(self, keys, init_leaves, dtypes):
+        calls[0] += 1
+        if calls[0] == crash_call:
+            raise InjectedCrash(f"killed mid-promote "
+                                f"(take_rows call #{calls[0]})")
+        return orig_tr(self, keys, init_leaves, dtypes)
+
+    ColdStore.take_rows = dying_tr
+    try:
+        g.run()  # recovers in-process; raising here fails the round
+    finally:
+        ColdStore.take_rows = orig_tr
+
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    reps = [r for o in st["Operators"] if o["name"] == "tscan"
+            for r in o["replicas"]]
+    promotes = sum(r.get("Tier_promotes", 0) for r in reps)
+    segs = committed(txn)
+    problems = []
+    if calls[0] < crash_call:
+        problems.append(f"injected promote crash never fired "
+                        f"({calls[0]} take_rows calls < {crash_call})")
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if promotes <= 0:
+        problems.append("tiered map reported no promotes after recovery")
+    if segs != golden:
+        dup = len(segs) - len(set(segs))
+        lost = len([x for x in golden if x not in set(segs)])
+        problems.append(f"committed records diverge from golden: "
+                        f"{dup} duplicate(s), {lost} lost "
+                        f"(got {len(segs)}, want {len(golden)})")
+    report.update(ok=not problems, problems=problems,
+                  results=len(golden), promotes=promotes,
+                  restarts=sup.get("Supervision_restarts", 0),
+                  mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
+
+
 def _device_loss_round(rng, report, workdir) -> dict:
     """``device_loss``: the failover acceptance round. An 8-device mesh
     pipeline loses a device mid-stream (static probe reports it dead,
@@ -738,6 +864,9 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
         # runs its own (mesh) golden pipeline — the CPU-windows golden
         # below would be wasted work
         return _mesh_kill_round(rng, report, workdir)
+    if scenario == "tiered_kill":
+        # runs its own (tiered) golden pipeline, like mesh_kill
+        return _tiered_kill_round(rng, report, workdir)
     if scenario == "device_loss":
         return _device_loss_round(rng, report, workdir)
     golden = _golden(workdir, n, nk)
@@ -913,6 +1042,11 @@ def main() -> int:
                          "supervision ON): the sharded state must restore "
                          "from its per-shard checkpoint blocks with "
                          "byte-identical exactly-once output")
+    ap.add_argument("--tiered", action="store_true",
+                    help="kill a tiered-state pipeline mid-promote "
+                         "(hot/cold keyed store, supervision ON): both "
+                         "tiers must restore from the checkpoint with "
+                         "byte-identical exactly-once output")
     ap.add_argument("--storage", action="store_true",
                     help="seeded storage-fault scenarios (truncate blob, "
                          "bit-flip blob, delete manifest, ENOSPC during "
@@ -934,6 +1068,8 @@ def main() -> int:
         scenarios = ("overload_kill",)
     elif args.mesh:
         scenarios = ("mesh_kill",)
+    elif args.tiered:
+        scenarios = ("tiered_kill",)
     elif args.storage:
         scenarios = STORAGE_SCENARIOS
     else:

@@ -107,6 +107,15 @@ REQUIRED_FAMILIES = (
     "windflow_ingest_blocks_total",
     "windflow_ingest_rows_per_block_avg",
     "windflow_ingest_block_ns_per_row",
+    # tiered keyed state (a fourth graph runs a with_tiering stateful
+    # map whose key set overflows the hot tier, so the Tier_* stats —
+    # emitted only on tiered replicas — carry real samples)
+    "windflow_tier_hot_keys",
+    "windflow_tier_cold_keys",
+    "windflow_tier_promotes_total",
+    "windflow_tier_demotes_total",
+    "windflow_tier_promote_seconds_total",
+    "windflow_tier_miss_rate",
 )
 
 _SAMPLE_RE = re.compile(
@@ -258,6 +267,46 @@ def run_columnar_graph():
         "columnar source reported no ingest blocks"
 
 
+def run_tiered_graph():
+    """A fourth tiny graph exercising the tiered keyed-state store: a
+    stateful map whose distinct key set (20) overflows the hot tier
+    (8), so promotes/demotes fire and the ``windflow_tier_*`` families
+    carry real samples."""
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    def src(shipper):
+        for i in range(2_000):
+            shipper.push({"k": i % 20, "v": float(i + 1)})
+
+    seen = [0]
+    g = PipeGraph("check_metrics_tiered", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    op = (Map_TPU_Builder(
+            lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                             st + row["v"]))
+          .with_state(np.float32(0)).with_key_by("k")
+          .with_tiering(policy="lru", hot_capacity=8)
+          .with_name("tscan").build())
+    # batch size 8: each batch's distinct-key working set fits the hot
+    # tier while the 20-key stream forces steady promote/demote churn
+    g.add_source(Source_Builder(src).with_name("tsrc")
+                 .with_output_batch_size(8).build()) \
+        .add(op) \
+        .add_sink(Sink_Builder(
+            lambda t: seen.__setitem__(0, seen[0] + 1) if t else None)
+            .with_name("tout").build())
+    g.run()
+    assert seen[0] == 2_000, f"tiered sink saw {seen[0]} tuples"
+    reps = [o for o in g.get_stats()["Operators"]
+            if o["name"] == "tscan"][0]["replicas"]
+    assert sum(r.get("Tier_promotes", 0) for r in reps) > 0, \
+        "tiered map reported no promotes"
+
+
 def run_graph_and_scrape():
     """Run the tiny graph against a fresh server; return (metrics text,
     /trace document, pre-run /metrics status code)."""
@@ -350,6 +399,9 @@ def run_graph_and_scrape():
         # the columnar-ingest leg: a block source feeds the device map
         # so the windflow_ingest_* families carry non-zero samples
         run_columnar_graph()
+        # the tiered-state leg: the key set overflows the hot tier so
+        # the windflow_tier_* families carry non-zero samples
+        run_tiered_graph()
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land
         import time
@@ -358,7 +410,8 @@ def run_graph_and_scrape():
             reports = server.snapshot()["reports"]
             if "check_metrics" in reports \
                     and "check_metrics_mesh" in reports \
-                    and "check_metrics_columnar" in reports:
+                    and "check_metrics_columnar" in reports \
+                    and "check_metrics_tiered" in reports:
                 break
             time.sleep(0.05)
         else:

@@ -1244,6 +1244,102 @@ def bench_ingest() -> None:
                           r["Ingest_block_ns_per_row"]}))
 
 
+def bench_tiering() -> None:
+    """--tiering: the tiered keyed-state store (windflow_tpu.state) on
+    the keyed device scan. Two interleaved gate legs, best-of-N:
+
+    - ``dense``        — plain with_state (all keys device-resident);
+    - ``hot_resident`` — with_tiering, hot tier 2x the key set: every
+      key stays hot after the first fill, so the ONLY added cost is the
+      per-batch plan (one tracker touch per distinct key, no movement).
+      Acceptance gate: <= 2% vs dense — tiering off the movement path
+      must be free.
+
+    Plus one informational cold-churn leg: a key space 16x the hot tier
+    with round-robin keys, the pathological case where EVERY batch swaps
+    its full working set through the sqlite cold store. Reports
+    tuples/s, the per-batch promote cost from the Tier_* counters, and
+    the miss rate — the number PERF.md quotes for "when dense still
+    wins"."""
+    from windflow_tpu import (ExecutionMode, PipeGraph, Sink_Builder,
+                              Source_Builder, TimePolicy)
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    # host-process dispatch dominates this shape and run-to-run wall
+    # variance is large (±25% per pass on shared hosts) — many short
+    # interleaved passes with best-of, not few long ones, or the gate
+    # measures scheduler luck instead of tier cost
+    N, B, REPS, NK = 100_000, 512, 10, 64
+
+    def one_pass(nk, hot_capacity, n=N, batch=B):
+        def src(shipper):
+            for v in range(n):
+                shipper.push({"k": v % nk, "v": float(v)})
+
+        seen = [0]
+        mb = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_name("scan"))
+        if hot_capacity:
+            mb = mb.with_tiering(policy="lru", hot_capacity=hot_capacity)
+        g = PipeGraph("mb_tiering", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(batch).build()) \
+         .add(mb.build()) \
+         .add_sink(Sink_Builder(lambda t: seen.__setitem__(0, seen[0] + 1)
+                                if t else None).with_name("snk").build())
+        t0 = time.perf_counter()
+        g.run()
+        tps = n / (time.perf_counter() - t0)
+        assert seen[0] == n, f"sink saw {seen[0]} of {n}"
+        rep = [o for o in g.get_stats()["Operators"]
+               if o["name"] == "scan"][0]["replicas"][0]
+        return tps, rep
+
+    legs = (("dense", NK, 0), ("hot_resident", NK, 2 * NK))
+    best = {label: (0.0, None) for label, _, _ in legs}
+    for _ in range(REPS):
+        for label, nk, hot in legs:
+            tps, rep = one_pass(nk, hot)
+            if tps > best[label][0]:
+                best[label] = (tps, rep)
+    for label, _, _ in legs:
+        report(f"tiering_{label}", best[label][0])
+    base = best["dense"][0]
+    pct = (100.0 * (1.0 - best["hot_resident"][0] / base) if base else 0.0)
+    print(json.dumps({"bench": "tiering_hot_resident_overhead_pct",
+                      "value": round(pct, 2), "unit": "pct",
+                      "acceptance": "<=2% with the working set "
+                                    "hot-resident (no movement)"}))
+    hr = best["hot_resident"][1]
+    print(json.dumps({"bench": "tiering_hot_resident_counters",
+                      "promotes": hr.get("Tier_promotes", 0),
+                      "demotes": hr.get("Tier_demotes", 0),
+                      "miss_rate": hr.get("Tier_miss_rate", 0.0)}))
+
+    # informational cold-churn leg: key space 16x the hot tier, round-
+    # robin keys — every batch swaps its whole working set through the
+    # cold store (the adversarial bound, NOT the Zipf steady state)
+    hot, nk_cold, b_cold, n_cold = 256, 4096, 256, 100_000
+    tps_c, rep_c = one_pass(nk_cold, hot, n=n_cold, batch=b_cold)
+    promotes = rep_c.get("Tier_promotes", 0)
+    usec = rep_c.get("Tier_promote_usec_total", 0.0)
+    report("tiering_cold_churn", tps_c)
+    print(json.dumps({"bench": "tiering_cold_churn_detail",
+                      "hot_capacity": hot, "key_space": nk_cold,
+                      "miss_rate": rep_c.get("Tier_miss_rate", 0.0),
+                      "promotes": promotes,
+                      "promote_usec_per_key":
+                          round(usec / promotes, 2) if promotes else 0.0,
+                      "note": "informational: round-robin over 16x the "
+                              "hot tier thrashes by design — dense "
+                              "still wins when the working set cycles "
+                              "faster than the policy can rank it"}))
+
+
 def bench_restart() -> None:
     """--restart: cold-vs-warm restart-to-first-tuple time with the JAX
     persistent compilation cache (WF_COMPILE_CACHE_DIR /
@@ -1461,6 +1557,9 @@ def main() -> None:
         return
     if "--ingest" in sys.argv[1:]:
         bench_ingest()
+        return
+    if "--tiering" in sys.argv[1:]:
+        bench_tiering()
         return
     bench_staging()
     bench_reshard()

@@ -73,6 +73,18 @@ def test_chaos_mesh_kill(tmp_path):
     assert rep["restarts"] == 1
 
 
+@pytest.mark.parametrize("seed", [3, 21])
+def test_chaos_tiered_kill(tmp_path, seed):
+    """Kill a tiered-state pipeline MID-PROMOTE under supervision (the
+    Nth cold read crashes the worker after the checkpoints committed):
+    both tiers restore from the checkpoint and the exactly-once output
+    stays byte-identical to an uninterrupted run."""
+    rep = chaos.run_round(seed, "tiered_kill", str(tmp_path))
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] == 1
+    assert rep["promotes"] > 0
+
+
 @pytest.mark.parametrize("scenario", ["storage_truncate", "storage_bitflip",
                                       "storage_manifest"])
 def test_chaos_storage_corruption(tmp_path, scenario):

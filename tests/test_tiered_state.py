@@ -1,0 +1,451 @@
+"""Tiered keyed state (windflow_tpu.state): hot keys device-resident,
+cold tail spilled to a host sqlite store, promoted/demoted per batch.
+
+The acceptance invariant everywhere: a tiered pipeline produces results
+IDENTICAL to the dense (all-keys-device-resident) run — tier movement is
+pure data placement, never semantics. Movement must also be *batched*:
+one gather + one scatter per batch regardless of how many keys moved.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (ExecutionMode, KeyCapacityError, PipeGraph,
+                          Sink_Builder, Source_Builder, TimePolicy,
+                          WindFlowError)
+from windflow_tpu.state import TierConfig, TieredKeyStore
+from windflow_tpu.tpu import Map_TPU_Builder
+from windflow_tpu.tpu.keymap import KeySlotMap
+
+
+class InjectedCrash(Exception):
+    pass
+
+
+class ReplaySource:
+    """Deterministic replayable source: integers 0..n-1 keyed ``v % nk``,
+    checkpoint requested at ``ckpt_at``, crash injected at ``crash_at``."""
+
+    def __init__(self, n, nk, ckpt_at=None, crash_at=None, seed=None):
+        self.n = n
+        self.nk = nk
+        self.ckpt_at = ckpt_at
+        self.crash_at = crash_at
+        self.pos = 0
+        self.keys = list(range(nk)) if seed is None else \
+            [random.Random(seed + i).randrange(nk) for i in range(n)]
+        self.seeded = seed is not None
+
+    def __call__(self, shipper):
+        while self.pos < self.n:
+            if self.crash_at is not None and self.pos == self.crash_at:
+                raise InjectedCrash(f"killed at tuple {self.pos}")
+            v = self.pos
+            k = self.keys[v] if self.seeded else v % self.nk
+            shipper.push({"k": k, "v": float(v + 1)})
+            self.pos += 1
+            if self.ckpt_at is not None and self.pos == self.ckpt_at:
+                assert shipper.request_checkpoint() is not None
+
+    def snapshot_position(self):
+        return self.pos
+
+    def restore(self, pos):
+        self.pos = pos
+
+
+def _running_sum_op(name, tiering=None, batch=8, **kw):
+    # column-preserving map: the running sum replaces "v" (the TPU
+    # staging exit reuses the input schema)
+    b = (Map_TPU_Builder(
+            lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                             st + row["v"]))
+         .with_state(np.float32(0)).with_key_by("k").with_name(name))
+    if tiering is not None:
+        b = b.with_tiering(**tiering)
+    for k, v in kw.items():
+        meth = getattr(b, f"with_{k}")
+        b = meth(**v) if isinstance(v, dict) else meth(v)
+    return b.build()
+
+
+def _run_graph(gname, src, op, store_dir=None, batch=8):
+    rows, lock = [], threading.Lock()
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                rows.append((int(t["k"]), float(t["v"])))
+
+    g = PipeGraph(gname, ExecutionMode.DEFAULT, TimePolicy.INGRESS_TIME)
+    if store_dir is not None:
+        g.with_checkpointing(store_dir=store_dir)
+    g.add_source(Source_Builder(src).with_name("src")
+                 .with_output_batch_size(batch).build()) \
+        .add(op) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    return g, rows
+
+
+# ---------------------------------------------------------------------------
+# the acceptance invariant: tiered == dense, byte for byte
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("policy", ["lru", "lfu"])
+def test_tiered_vs_dense_randomized_differential(policy):
+    """Randomized key stream through the same running-sum scan, dense vs
+    tiered with a hot tier ~1/3 of the key space: per-key running sums
+    must be byte-identical (same float32 accumulation order)."""
+    n, nk = 1_500, 24
+    dense_g, dense_rows = _run_graph(
+        f"tier_diff_dense_{policy}", ReplaySource(n, nk, seed=11),
+        _running_sum_op("scan"))
+    dense_g.run()
+    tiered_g, tiered_rows = _run_graph(
+        f"tier_diff_{policy}", ReplaySource(n, nk, seed=11),
+        _running_sum_op("scan", tiering=dict(policy=policy,
+                                             hot_capacity=8)))
+    tiered_g.run()
+    assert len(dense_rows) == n
+    assert sorted(tiered_rows) == sorted(dense_rows)
+
+
+# ---------------------------------------------------------------------------
+# batching: one gather + one scatter per batch, never per key
+# ---------------------------------------------------------------------------
+def test_promote_demote_are_batched(monkeypatch):
+    """Every batch alternates between two disjoint 8-key working sets, so
+    each batch promotes 8 keys and demotes 8. The store must move them in
+    ONE promote batch and ONE demote batch per stream batch — per-key
+    device transfers would show up as batches == keys."""
+    from windflow_tpu.state import tiered as tiered_mod
+
+    created = []
+    orig = tiered_mod.TieredKeyStore.__init__
+
+    def spy(self, *a, **kw):
+        orig(self, *a, **kw)
+        created.append(self)
+
+    monkeypatch.setattr(tiered_mod.TieredKeyStore, "__init__", spy)
+
+    n_rounds = 20
+
+    def src(shipper):
+        for r in range(n_rounds):
+            base = 0 if r % 2 == 0 else 8
+            for i in range(8):
+                shipper.push({"k": base + i, "v": 1.0})
+
+    g, rows = _run_graph("tier_batching", src,
+                         _running_sum_op("scan",
+                                         tiering=dict(policy="lru",
+                                                      hot_capacity=8)))
+    g.run()
+    assert len(rows) == n_rounds * 8
+    assert len(created) == 1
+    store = created[0]
+    # every round after the first swaps the full 8-key working set
+    assert store.promoted_keys == 8 + (n_rounds - 1) * 8
+    assert store.demoted_keys == (n_rounds - 1) * 8
+    # the batching invariant: one scatter per round, not one per key
+    assert store.promote_batches <= n_rounds
+    assert store.demote_batches <= n_rounds - 1
+    assert store.promoted_keys >= 8 * store.promote_batches
+    assert store.demoted_keys >= 8 * store.demote_batches
+
+
+# ---------------------------------------------------------------------------
+# checkpoint plane: kill mid-stream, restore BOTH tiers
+# ---------------------------------------------------------------------------
+def test_tiered_kill_and_restore_both_tiers(tmp_path):
+    """Tiered scan killed after a checkpoint: the restore must bring back
+    the hot table AND the cold sqlite image (a key demoted before the
+    checkpoint must resume its running sum, not restart at init)."""
+    n, nk = 1_000, 20
+    golden_g, golden = _run_graph(
+        "tier_ck_gold", ReplaySource(n, nk),
+        _running_sum_op("scan", tiering=dict(policy="lru",
+                                             hot_capacity=8)))
+    golden_g.run()
+    assert len(golden) == n
+
+    store = str(tmp_path / "store")
+    g, rows = _run_graph(
+        "tier_ck", ReplaySource(n, nk, ckpt_at=480, crash_at=700),
+        _running_sum_op("scan", tiering=dict(policy="lru",
+                                             hot_capacity=8)),
+        store_dir=store)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    g2, rows2 = _run_graph(
+        "tier_ck", ReplaySource(n, nk),
+        _running_sum_op("scan", tiering=dict(policy="lru",
+                                             hot_capacity=8)),
+        store_dir=store)
+    g2.run(restore_from=store)
+    # the restored run replays the suffix: its max running sum per key
+    # must match the crash-free run exactly (lost cold rows would reset
+    # some key's sum; lost hot rows would reset others)
+    def per_key_max(rows_):
+        out = {}
+        for k, run in rows_:
+            out[k] = max(out.get(k, 0.0), run)
+        return out
+
+    assert per_key_max(rows + rows2) == per_key_max(golden)
+
+
+def test_tiered_blob_refused_by_dense_graph(tmp_path):
+    """A checkpoint taken with tiering on cannot silently restore into a
+    dense graph (the cold rows would vanish): the engine refuses."""
+    n, nk = 600, 20
+    store = str(tmp_path / "store")
+    g, _ = _run_graph(
+        "tier_mig", ReplaySource(n, nk, ckpt_at=300, crash_at=450),
+        _running_sum_op("scan", tiering=dict(policy="lru",
+                                             hot_capacity=8)),
+        store_dir=store)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    g2, _ = _run_graph("tier_mig", ReplaySource(n, nk),
+                       _running_sum_op("scan"), store_dir=store)
+    with pytest.raises(WindFlowError):
+        g2.run(restore_from=store)
+
+
+def test_dense_blob_adopted_by_tiered_graph(tmp_path):
+    """The reverse migration is allowed: a dense checkpoint restores into
+    a tiered graph (all keys adopted hot) when they fit the hot tier."""
+    n, nk = 600, 6
+    golden_g, golden = _run_graph("tier_adopt_gold", ReplaySource(n, nk),
+                                  _running_sum_op("scan"))
+    golden_g.run()
+    store = str(tmp_path / "store")
+    g, rows = _run_graph(
+        "tier_adopt", ReplaySource(n, nk, ckpt_at=300, crash_at=450),
+        _running_sum_op("scan"), store_dir=store)
+    with pytest.raises(InjectedCrash):
+        g.run()
+    g2, rows2 = _run_graph(
+        "tier_adopt", ReplaySource(n, nk),
+        _running_sum_op("scan", tiering=dict(policy="lru",
+                                             hot_capacity=16)),
+        store_dir=store)
+    g2.run(restore_from=store)
+
+    def per_key_max(rows_):
+        out = {}
+        for k, run in rows_:
+            out[k] = max(out.get(k, 0.0), run)
+        return out
+
+    assert per_key_max(rows + rows2) == per_key_max(golden)
+
+
+# ---------------------------------------------------------------------------
+# elastic rescale with tiering on: both tiers repartition
+# ---------------------------------------------------------------------------
+def test_live_rescale_tiered_map(tmp_path):
+    """Live 2 -> 3 rescale of a tiered stateful map: the repartitioner
+    splits hot tables by eviction rank AND re-buckets the cold sqlite
+    rows; every key's running sum survives the move."""
+    n_keys, per_key = 20, 200
+    acc, lock = {}, threading.Lock()
+    counted = [0]
+    gate = threading.Event()
+
+    class ColSource:
+        def __init__(self):
+            self.pos = 0
+
+        def __call__(self, shipper):
+            while self.pos < per_key:
+                if self.pos == per_key // 2:
+                    gate.wait(30)
+                v = self.pos + 1
+                for k in range(n_keys):
+                    shipper.push({"k": k, "v": float(v)})
+                self.pos += 1
+
+        def snapshot_position(self):
+            return self.pos
+
+        def restore(self, pos):
+            self.pos = pos
+
+    src_f = ColSource()
+    g = PipeGraph("rs_tier", ExecutionMode.DEFAULT,
+                  TimePolicy.INGRESS_TIME)
+    g.with_checkpointing(store_dir=str(tmp_path / "tier"))
+    m = _running_sum_op("tscan",
+                        tiering=dict(policy="lru", hot_capacity=16),
+                        parallelism=2)
+
+    def sink(t):
+        if t is not None:
+            with lock:
+                acc[int(t["k"])] = max(acc.get(int(t["k"]), 0.0),
+                                       float(t["v"]))
+                counted[0] += 1
+
+    g.add_source(Source_Builder(src_f).with_name("src")
+                 .with_output_batch_size(8).build()) \
+        .add(m) \
+        .add_sink(Sink_Builder(sink).with_name("snk").build())
+    g.start()
+    while src_f.pos < per_key // 2:
+        time.sleep(0.01)
+    threading.Timer(0.3, gate.set).start()
+    rep = g.rescale("tscan", 3, timeout_s=60)
+    g.wait_end()
+    assert rep.changed
+    total = float(per_key * (per_key + 1) // 2)
+    # a lost/misrouted hot table or cold row restarts some key's sum
+    assert acc == {k: total for k in range(n_keys)}
+    assert counted[0] == n_keys * per_key
+
+
+# ---------------------------------------------------------------------------
+# policy semantics: LRU and LFU diverge under skew
+# ---------------------------------------------------------------------------
+def _feed(store, keymap, keys):
+    plan = store.plan_batch(keymap, keys)
+    if plan is not None:
+        # unit-level stand-in for the engine's data movement
+        store.cold.put_rows(plan.demote_keys,
+                            [np.zeros(len(plan.demote_keys),
+                                      dtype=np.float32)])
+        store.cold.take_rows(plan.promote_keys, [np.float32(0)],
+                             [np.dtype(np.float32)])
+    return plan
+
+
+def test_lru_vs_lfu_divergence_under_skew(tmp_path):
+    """A heavy-hitter key touched in many early batches, then a scan of
+    one-shot keys: LFU keeps the heavy hitter hot (frequency wins), LRU
+    demotes it (recency wins). Both remain byte-correct — only placement
+    differs — which is exactly why the policy knob exists."""
+    stores = {}
+    for policy in ("lru", "lfu"):
+        cfg = TierConfig(policy=policy, hot_capacity=4,
+                         db_dir=str(tmp_path / policy))
+        store = TieredKeyStore(f"skew_{policy}", cfg)
+        km = KeySlotMap()
+        _feed(store, km, [1, 2, 3, 4])
+        for _ in range(10):           # key 1 becomes the heavy hitter
+            _feed(store, km, [1])
+        for k in range(5, 12):        # one-shot cold scan
+            _feed(store, km, [k])
+        stores[policy] = (store, set(km.slot_of_key))
+    assert 1 in stores["lfu"][1], "LFU demoted the heavy hitter"
+    assert 1 not in stores["lru"][1], "LRU kept a stale key hot"
+    assert stores["lru"][1] != stores["lfu"][1]
+    for store, hot in stores.values():
+        assert len(hot) == 4
+        assert len(store.cold) == 11 - 4   # 11 distinct keys ever seen
+        store.cold.close()
+
+
+def test_zipf_miss_rates_stay_bounded(tmp_path):
+    """Under a Zipf-skewed stream whose head fits the hot tier, both
+    policies converge to a low miss rate — the whole point of tiering."""
+    rng = random.Random(7)
+    zipf = [min(int(rng.paretovariate(1.1)), 200) for _ in range(4_000)]
+    for policy in ("lru", "lfu"):
+        cfg = TierConfig(policy=policy, hot_capacity=64,
+                         db_dir=str(tmp_path / f"z_{policy}"))
+        store = TieredKeyStore(f"zipf_{policy}", cfg)
+        km = KeySlotMap()
+        for i in range(0, len(zipf), 16):
+            _feed(store, km, list(dict.fromkeys(zipf[i:i + 16])))
+        assert store.lookups > 0
+        miss_rate = store.misses / store.lookups
+        assert miss_rate < 0.30, (policy, miss_rate)
+        store.cold.close()
+
+
+# ---------------------------------------------------------------------------
+# capacity refusals: typed, loud, actionable
+# ---------------------------------------------------------------------------
+def test_key_capacity_error_fields():
+    e = KeyCapacityError("scan", 64, 3, hint="raise with_key_capacity")
+    assert isinstance(e, WindFlowError)
+    assert e.op_name == "scan" and e.k_pad == 64 and e.refused == 3
+    assert "scan" in str(e) and "64" in str(e) and "3" in str(e)
+    assert "raise with_key_capacity" in str(e)
+
+
+def test_batch_wider_than_hot_tier_refused(tmp_path):
+    cfg = TierConfig(policy="lru", hot_capacity=4,
+                     db_dir=str(tmp_path / "wide"))
+    store = TieredKeyStore("wide", cfg)
+    km = KeySlotMap()
+    with pytest.raises(KeyCapacityError) as ei:
+        store.plan_batch(km, list(range(7)))
+    assert ei.value.k_pad == 4 and ei.value.refused == 3
+    store.cold.close()
+
+
+def test_mesh_key_overflow_without_tiering_is_typed():
+    """The mesh plane's dense capacity refusal is the typed error now —
+    scripts that caught WindFlowError keep working, new code can catch
+    KeyCapacityError and react (enable tiering, raise capacity)."""
+    def src(shipper):
+        for i in range(64):
+            shipper.push({"k": i, "v": 1.0})
+
+    g, _ = _run_graph("mesh_overflow", src,
+                      _running_sum_op("mscan", mesh=dict(key_capacity=8)))
+    with pytest.raises(KeyCapacityError):
+        g.run()
+
+
+def test_governor_shrink_never_blocks_servable_batch(tmp_path):
+    """A governor-shrunk target below the batch working set must NOT
+    refuse the batch: the physical tier still holds it; shrinking simply
+    resumes when the working set allows."""
+    cfg = TierConfig(policy="lru", hot_capacity=8,
+                     db_dir=str(tmp_path / "gov"))
+    store = TieredKeyStore("gov", cfg)
+    km = KeySlotMap()
+    _feed(store, km, list(range(8)))
+    store.target_hot_capacity = store.min_hot = 2
+    plan = _feed(store, km, list(range(8)))   # 8 keys > target 2: fine
+    assert plan is None or len(plan.promote_keys) == 0
+    assert len(km.slot_of_key) == 8
+    plan = _feed(store, km, [0, 1])           # now shrinking engages
+    assert plan is not None and len(plan.demote_keys) == 6
+    assert len(km.slot_of_key) == 2
+    store.cold.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh plane: tiering composes with the sharded key table
+# ---------------------------------------------------------------------------
+@pytest.mark.mesh
+def test_mesh_tiered_matches_dense(tmp_path):
+    """The same differential on the mesh plane: a block-sharded hot
+    table with host spill equals the dense mesh run."""
+    n, nk = 1_200, 24
+
+    def build(tiered):
+        kw = dict(mesh=dict(key_capacity=8 if tiered else nk))
+        if tiered:
+            kw["tiering"] = dict(policy="lru", hot_capacity=8)
+        return _run_graph(f"mesh_tier_{tiered}",
+                          ReplaySource(n, nk, seed=3),
+                          _running_sum_op("mscan", **kw))
+
+    dg, dense_rows = build(False)
+    dg.run()
+    tg, tiered_rows = build(True)
+    tg.run()
+    assert len(dense_rows) == n
+    assert sorted(tiered_rows) == sorted(dense_rows)

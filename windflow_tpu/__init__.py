@@ -22,8 +22,8 @@ shim), ``windflow_tpu.persistent`` (out-of-core keyed state),
 ``windflow_tpu.kafka`` (connectors), ``windflow_tpu.monitoring``.
 """
 
-from .basic import (ExecutionMode, JoinMode, RoutingMode, TimePolicy,
-                    WindFlowError, WinType)
+from .basic import (ExecutionMode, JoinMode, KeyCapacityError, RoutingMode,
+                    TimePolicy, WindFlowError, WinType)
 from .builders import (Columnar_Source_Builder, Ffat_Windows_Builder,
                        Filter_Builder, Interval_Join_Builder,
                        FlatMap_Builder, Keyed_Windows_Builder, Map_Builder,
@@ -54,7 +54,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ExecutionMode", "TimePolicy", "WinType", "RoutingMode", "JoinMode",
-    "WindFlowError", "FencedWriteError", "CorruptCheckpointError",
+    "WindFlowError", "KeyCapacityError", "FencedWriteError",
+    "CorruptCheckpointError",
     "PipeGraph", "MultiPipe",
     "Source", "Columnar_Source", "Map", "Filter", "FlatMap", "Reduce", "Sink",
     "SourceShipper", "Shipper",

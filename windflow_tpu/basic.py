@@ -118,6 +118,28 @@ class WindFlowError(RuntimeError):
     ``exit(EXIT_FAILURE)``; we raise instead so tests can assert on misuse."""
 
 
+class KeyCapacityError(WindFlowError):
+    """A keyed device structure refused new keys: the distinct-key count
+    exceeded the declared dense capacity (``K_pad`` — the padded slot
+    count of the device table). Typed so callers can tell "grow the
+    capacity / enable tiering" apart from generic topology errors, and
+    carries the operator, the padded capacity, and how many keys were
+    refused. This stays the loud failure mode when tiering is NOT
+    enabled; ``with_tiering(...)`` makes the capacity elastic instead."""
+
+    def __init__(self, op_name: str, k_pad: int, refused: int,
+                 hint: str = "") -> None:
+        self.op_name = op_name
+        self.k_pad = int(k_pad)
+        self.refused = int(refused)
+        msg = (f"{op_name}: {self.refused} new key(s) refused — distinct "
+               f"key count exceeds the device key capacity K_pad="
+               f"{self.k_pad}")
+        if hint:
+            msg += f"; {hint}"
+        super().__init__(msg)
+
+
 class RescaleTeardown(BaseException):
     """Internal control-flow signal of the elastic-rescale plane
     (``windflow_tpu.scaling``): a worker parked at a rescale barrier is

@@ -169,9 +169,12 @@ class FfatMeshReplica(TPUReplicaBase):
 
     def _on_new_key(self, key, slot: int) -> None:
         if slot >= self.op.key_capacity:
-            raise WindFlowError(
-                f"{self.op.name}: distinct key count exceeds key_capacity="
-                f"{self.op.key_capacity}; raise with_key_capacity")
+            from ..basic import KeyCapacityError
+            raise KeyCapacityError(
+                self.op.name,
+                getattr(self, "_K_pad", 0) or self.op.key_capacity,
+                slot - self.op.key_capacity + 1,
+                hint="raise with_key_capacity")
         self._key_by_slot[slot] = key
 
     # -- lazy mesh/program construction ---------------------------------

@@ -42,7 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..basic import OpType, RoutingMode, WindFlowError
+from ..basic import KeyCapacityError, OpType, RoutingMode, WindFlowError
 from ..tpu.batch import BatchTPU, bucket_capacity
 from ..tpu.ops_tpu import TPUOperatorBase, TPUReplicaBase, cached_compile
 from ..tpu.schema import TupleSchema
@@ -87,7 +87,8 @@ class Map_Mesh(_MeshKeyedOperator):
                  n_devices: Optional[int] = None,
                  mesh_shape: Optional[tuple] = None,
                  local_batch: Optional[int] = None,
-                 schema: Optional[TupleSchema] = None) -> None:
+                 schema: Optional[TupleSchema] = None,
+                 tiering=None) -> None:
         if state_init is None:
             raise WindFlowError(
                 f"{name}: with_mesh applies to the KEYED-STATE plane; a "
@@ -97,6 +98,7 @@ class Map_Mesh(_MeshKeyedOperator):
                          n_devices, mesh_shape, local_batch)
         self.func = func
         self.state_init = state_init
+        self.tiering = tiering
 
     def build_replicas(self) -> None:
         self.replicas = [MapMeshReplica(self, 0)]
@@ -111,7 +113,8 @@ class Filter_Mesh(_MeshKeyedOperator):
                  n_devices: Optional[int] = None,
                  mesh_shape: Optional[tuple] = None,
                  local_batch: Optional[int] = None,
-                 schema: Optional[TupleSchema] = None) -> None:
+                 schema: Optional[TupleSchema] = None,
+                 tiering=None) -> None:
         if state_init is None:
             raise WindFlowError(
                 f"{name}: with_mesh applies to the KEYED-STATE plane; a "
@@ -121,6 +124,7 @@ class Filter_Mesh(_MeshKeyedOperator):
                          n_devices, mesh_shape, local_batch)
         self.pred = pred
         self.state_init = state_init
+        self.tiering = tiering
 
     def build_replicas(self) -> None:
         self.replicas = [FilterMeshReplica(self, 0)]
@@ -175,12 +179,15 @@ class _MeshReplicaBase(TPUReplicaBase):
         self._gpos_dev = None
         self._step_bytes = 0
         self._pending_restore: Optional[dict] = None
+        self._tier = None  # _MeshScanReplicaBase builds it when declared
 
     def _on_new_key(self, key, slot: int) -> None:
         if slot >= self.op.key_capacity:
-            raise WindFlowError(
-                f"{self.op.name}: distinct key count exceeds key_capacity="
-                f"{self.op.key_capacity}; raise with_mesh(key_capacity=)")
+            raise KeyCapacityError(
+                self.op.name, self._K_pad or self.op.key_capacity,
+                slot - self.op.key_capacity + 1,
+                hint="raise with_mesh(key_capacity=) or enable "
+                     "with_tiering to spill the cold key tail")
         self._key_by_slot[slot] = key
 
     # -- lazy mesh/program construction ---------------------------------
@@ -255,6 +262,15 @@ class _MeshReplicaBase(TPUReplicaBase):
             raise WindFlowError(
                 f"{self.op.name}: mesh operators require integer keys "
                 f"(sparse/negative int64 ok); got dtype {keys.dtype}")
+        if self._tier is not None and n:
+            # tier pre-pass: the mesh replica commits synchronously (no
+            # deferred dispatch), so the batched promote/demote applies
+            # inline before the slot resolution
+            plan = self._tier.plan_batch(
+                self._keymap, [int(k) for k in np.unique(keys)])
+            if plan is not None:
+                self._apply_tier_plan(plan)
+            self._tier.publish_gauges(len(self._keymap))
         slots = np.asarray(self._keymap.slots_of(keys, keys, n),
                            dtype=np.int64)
         from .core import mesh_occupancy
@@ -329,11 +345,12 @@ class _MeshReplicaBase(TPUReplicaBase):
     def _restore_keymap(self, d: dict) -> None:
         op = self.op
         if len(d["slot_of_key"]) > op.key_capacity:
-            raise WindFlowError(
-                f"{op.name}: restore holds {len(d['slot_of_key'])} "
-                f"distinct keys but this graph declares key_capacity="
-                f"{op.key_capacity}; raise with_mesh(key_capacity=) to "
-                "at least the checkpointed count")
+            raise KeyCapacityError(
+                op.name, self._K_pad or op.key_capacity,
+                len(d["slot_of_key"]) - op.key_capacity,
+                hint="restore holds more distinct keys than this graph's "
+                     "key_capacity; raise with_mesh(key_capacity=) to at "
+                     "least the checkpointed count")
         self._keymap.slot_of_key.clear()
         self._keymap.slot_of_key.update(d["slot_of_key"])
         self._keymap._lut = None
@@ -354,10 +371,54 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
         super().__init__(op, idx)
         self._table = None
         self._out_schema: Optional[TupleSchema] = None
+        cfg = getattr(op, "tiering", None)
+        if cfg is not None:
+            if cfg.hot_capacity > op.key_capacity:
+                raise WindFlowError(
+                    f"{op.name}: with_tiering(hot_capacity="
+                    f"{cfg.hot_capacity}) exceeds with_mesh(key_capacity="
+                    f"{op.key_capacity}) — the mesh table IS the hot "
+                    "tier; raise key_capacity or lower hot_capacity")
+            from ..state.tiered import TieredKeyStore
+            self._tier = TieredKeyStore(f"{op.name}_mesh_tier", cfg,
+                                        stats=self.stats)
 
     @property
     def functor(self) -> Callable:
         raise NotImplementedError
+
+    def _apply_tier_plan(self, plan) -> None:
+        """Batched tier movement against the SHARDED table: one slot-row
+        gather per leaf feeds the cold writes, one scatter per leaf lands
+        the promotions (re-pinned to the mesh sharding — an eager
+        scatter's output sharding is XLA's choice, the table's is not)."""
+        import jax
+        import jax.numpy as jnp
+
+        tier = self._tier
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(self._table)
+        if len(plan.demote_keys):
+            dslots = jnp.asarray(plan.demote_slots)
+            cols = [np.asarray(jax.device_get(lf[dslots]))
+                    for lf in leaves]
+            tier.cold.put_rows(plan.demote_keys, cols)
+            tier.note_demote(len(plan.demote_keys))
+        if len(plan.promote_keys):
+            init_leaves = jax.tree_util.tree_leaves(self.op.state_init)
+            cols, _hits = tier.cold.take_rows(
+                plan.promote_keys, init_leaves,
+                [np.dtype(lf.dtype) for lf in leaves])
+            pslots = jnp.asarray(plan.promote_slots)
+            leaves = [jax.device_put(
+                          lf.at[pslots].set(jnp.asarray(col)),
+                          self._sharding)
+                      for lf, col in zip(leaves, cols)]
+            self._table = jax.tree_util.tree_unflatten(treedef, leaves)
+            for k, s in zip(plan.promote_keys, plan.promote_slots):
+                self._key_by_slot[int(s)] = k
+            tier.note_promote(len(plan.promote_keys),
+                              (time.perf_counter() - t0) * 1e6)
 
     def _after_mesh_ensure(self) -> None:
         import jax
@@ -454,6 +515,18 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
         return warmed
 
     # -- sharded fault tolerance ----------------------------------------
+    def _snapshot_extra(self) -> dict:
+        if self._tier is None:
+            return {}
+        import jax
+
+        from ..state.tiered import hot_table_digest
+
+        host = (None if self._table is None
+                else jax.device_get(self._table))
+        return {"tier": self._tier.snapshot(
+            hot_digest=hot_table_digest(host))}
+
     def _device_state_shards(self) -> Optional[list]:
         if self._table is None:
             return None
@@ -474,7 +547,25 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
 
         t0 = time.perf_counter()
         d, self._pending_restore = self._pending_restore, None
+        tier_blob = d.get("tier")
+        if tier_blob is not None and self._tier is None:
+            raise WindFlowError(
+                f"{self.op.name}: checkpoint holds a TIERED key store "
+                "but this graph was built without with_tiering(); "
+                "cold-tier keys cannot restore into a dense mesh table")
         self._restore_keymap(d)
+        if self._tier is not None:
+            if tier_blob is not None:
+                from ..state.tiered import hot_table_digest
+                shards_ = d.get("table_shards")
+                full_ = (None if shards_ is None else jax.tree_util.tree_map(
+                    lambda *parts: np.concatenate(parts, axis=0), *shards_))
+                self._tier.restore(tier_blob,
+                                   hot_digest=hot_table_digest(full_))
+            else:
+                # dense mesh checkpoint into a tiered graph: adopt every
+                # checkpointed key as hot (refused when they don't fit)
+                self._tier.adopt_dense(self._keymap.slot_of_key)
         shards = d.get("table_shards")
         if shards is None:
             return

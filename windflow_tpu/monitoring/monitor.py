@@ -245,6 +245,26 @@ _PROM_SCALARS = (
     ("windflow_mesh_degraded_devices", "gauge",
      "Devices this mesh replica runs WITHOUT (device-loss failover)",
      "Mesh_degraded_devices", 1),
+    # tiered keyed state (windflow_tpu.state): present only on replicas
+    # with with_tiering enabled (StatsRecord omits Tier_* elsewhere)
+    ("windflow_tier_hot_keys", "gauge",
+     "Keys resident in the device (hot) tier of the tiered key store",
+     "Tier_hot_keys", 1),
+    ("windflow_tier_cold_keys", "gauge",
+     "Keys spilled to the host (cold) tier of the tiered key store",
+     "Tier_cold_keys", 1),
+    ("windflow_tier_promotes_total", "counter",
+     "Keys promoted cold -> hot (batched slot-row scatters)",
+     "Tier_promotes", 1),
+    ("windflow_tier_demotes_total", "counter",
+     "Keys demoted hot -> cold (batched slot-row gathers)",
+     "Tier_demotes", 1),
+    ("windflow_tier_promote_seconds_total", "counter",
+     "Host-observed time spent in batched tier promote/demote movement",
+     "Tier_promote_usec_total", 1e-6),
+    ("windflow_tier_miss_rate", "gauge",
+     "Fraction of distinct batch keys absent from the hot tier",
+     "Tier_miss_rate", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)

@@ -78,6 +78,14 @@ class StatsRecord:
         # supervision plane excluded them (device-loss failover): > 0
         # means degraded capacity until the probe sees them return
         "mesh_degraded",
+        # tiered keyed state (windflow_tpu.state.tiered): hot/cold key
+        # gauges, batched promote/demote counters with promote time, and
+        # the lookup/miss counters behind Tier_miss_rate. tier_enabled
+        # marks a replica whose engine runs with_tiering — to_dict omits
+        # the Tier_* keys elsewhere, the Mesh_* discipline
+        "tier_enabled", "tier_hot_keys", "tier_cold_keys",
+        "tier_promotes", "tier_demotes", "tier_promote_usec_total",
+        "tier_lookups", "tier_misses",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -165,6 +173,14 @@ class StatsRecord:
         self.mesh_shard_occupancy = 0
         self.mesh_shard_skew = 0.0
         self.mesh_degraded = 0
+        self.tier_enabled = False
+        self.tier_hot_keys = 0
+        self.tier_cold_keys = 0
+        self.tier_promotes = 0
+        self.tier_demotes = 0
+        self.tier_promote_usec_total = 0.0
+        self.tier_lookups = 0
+        self.tier_misses = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -323,6 +339,29 @@ class StatsRecord:
             self.recorder.event("mesh:step", us,
                                 {"bytes": shuffle_bytes})
 
+    # -- tiered keyed state (windflow_tpu.state.tiered) -----------------------
+    def note_tier_promote(self, n_keys: int, usec: float) -> None:
+        """One BATCHED promote (cold rows -> one slot-row scatter):
+        ``n_keys`` keys moved hot in ``usec`` host-observed time."""
+        self.tier_promotes += n_keys
+        self.tier_promote_usec_total += usec
+        if self.recorder is not None:
+            self.recorder.event("tier:promote", usec, n_keys)
+
+    def note_tier_demote(self, n_keys: int) -> None:
+        """One BATCHED demote (slot-row gather -> cold writes)."""
+        self.tier_demotes += n_keys
+        if self.recorder is not None:
+            self.recorder.event("tier:demote", 0.0, n_keys)
+
+    def note_tier_gauges(self, hot: int, cold: int, lookups: int,
+                         misses: int) -> None:
+        self.tier_enabled = True
+        self.tier_hot_keys = hot
+        self.tier_cold_keys = cold
+        self.tier_lookups = lookups
+        self.tier_misses = misses
+
     # -- overload protection (windflow_tpu.overload) --------------------------
     def note_shed(self, n: int, nbytes: int) -> None:
         """Records shed by source admission control (never emitted, so
@@ -439,6 +478,17 @@ class StatsRecord:
             d["Mesh_shard_occupancy"] = self.mesh_shard_occupancy
             d["Mesh_shard_skew"] = self.mesh_shard_skew
             d["Mesh_degraded_devices"] = self.mesh_degraded
+        # -- tiered keyed state (with_tiering replicas only) ----------------
+        if self.tier_enabled:
+            d["Tier_hot_keys"] = self.tier_hot_keys
+            d["Tier_cold_keys"] = self.tier_cold_keys
+            d["Tier_promotes"] = self.tier_promotes
+            d["Tier_demotes"] = self.tier_demotes
+            d["Tier_promote_usec_total"] = round(
+                self.tier_promote_usec_total, 1)
+            d["Tier_miss_rate"] = round(
+                self.tier_misses / self.tier_lookups, 4) \
+                if self.tier_lookups else 0.0
         # -- queue / backpressure plane (0s for sources and fused chains) ---
         ch = self.input_channel
         d["Queue_len"] = len(ch) if ch is not None else 0

@@ -93,11 +93,17 @@ function render(snap){
     (st.Worker_errors? `<span class="badge warn">crashed `+
     `${Object.keys(st.Worker_errors).length} worker(s)</span>` : "");
   let total = 0, worstP99 = 0, rows = [];
+  let tierHot = 0, tierCold = 0, tierMiss = 0, tierOn = false;
   opNames = (st.Operators||[]).map(o=>o.name);
   (st.Operators||[]).forEach((o, oi) => {
     const r = o.replicas, s = (k)=>r.reduce((a,x)=>a+(x[k]||0),0);
     const m = (k)=>Math.max(...r.map(x=>x[k]||0));
     const tput = s("Throughput_tuples_sec"); total += tput;
+    if (r.some(x=>"Tier_hot_keys" in x)) {
+      tierOn = true; tierHot += s("Tier_hot_keys");
+      tierCold += s("Tier_cold_keys");
+      tierMiss = Math.max(tierMiss, m("Tier_miss_rate"));
+    }
     worstP99 = Math.max(worstP99, m("Latency_e2e_p99_usec"));
     rows.push(`<tr onclick="tog(${oi})"><td class=l>${esc(o.name)}</td>`+
       `<td class=l>${esc(o.kind)}</td><td>${o.parallelism|0}</td>`+
@@ -173,6 +179,11 @@ function render(snap){
     `<span class="badge warn">degraded: ${dg} device(s) excluded`+
     ((sv.Recovery_ladder_depth|0) ?
       ` · ladder depth ${sv.Recovery_ladder_depth|0}` : "")+`</span>`;
+  // tiered-keyed-state badge: hot/cold key split of the tiered stores
+  // (with_tiering) plus the worst per-replica hot-tier miss rate
+  if (tierOn) el("badges").innerHTML +=
+    `<span class=badge>tiered: ${fmt(tierHot)} hot / `+
+    `${fmt(tierCold)} cold · miss ${(tierMiss*100).toFixed(1)}%</span>`;
   const dlq = st.Dead_letters|0;
   if (dlq) el("badges").innerHTML +=
     `<span class="badge warn">dead letters ${fmt(dlq)}</span>`;

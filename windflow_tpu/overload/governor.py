@@ -479,6 +479,22 @@ class OverloadGovernor(threading.Thread):
         self._note("release", now, p99, SLO_STATES[pol.rung])
 
     # -- rung 1: tune ------------------------------------------------------
+    @staticmethod
+    def _tier_stores(r) -> list:
+        """Every TieredKeyStore a replica hosts: the single-chip engine's
+        (``r.engine.tier``), the mesh replica's (``r._tier``), or one per
+        stateful sub-engine of a fused chain (``r._engines``)."""
+        stores = []
+        eng = getattr(r, "engine", None)
+        if eng is not None and getattr(eng, "tier", None) is not None:
+            stores.append(eng.tier)
+        if getattr(r, "_tier", None) is not None:
+            stores.append(r._tier)
+        for sub in getattr(r, "_engines", ()) or ():
+            if sub is not None and getattr(sub, "tier", None) is not None:
+                stores.append(sub.tier)
+        return stores
+
     def _try_tune(self) -> bool:
         """Halve device dispatch depths and CPU-plane output batch sizes
         (recorded for restore). Returns False when there was nothing to
@@ -491,6 +507,18 @@ class OverloadGovernor(threading.Thread):
                     self._tuned.append((dq, "depth", dq.depth))
                     dq.depth = dq.depth // 2
                     touched = True
+                for tstore in self._tier_stores(r):
+                    # tiering lever: shrink the hot tier toward its floor
+                    # BEFORE the ladder reaches SHED — demotions free
+                    # device memory at the cost of cold misses, which is
+                    # still cheaper than dropping tuples
+                    cur = int(tstore.target_hot_capacity)
+                    nxt = max(tstore.min_hot, cur // 2)
+                    if nxt < cur:
+                        self._tuned.append(
+                            (tstore, "target_hot_capacity", cur))
+                        tstore.target_hot_capacity = nxt
+                        touched = True
                 em = getattr(r, "emitter", None)
                 # CPU-plane emitters only: shrinking a TPU staging
                 # emitter's batch would change its bucket signature and

@@ -63,6 +63,11 @@ class LRUCache:
     def items(self):
         return self._d.items()
 
+    def eviction_order(self):
+        """Keys in the order the policy would evict them (LRU first).
+        Snapshot before mutating — this iterates the live structure."""
+        return iter(self._d.keys())
+
 
 class LFUCache:
     """Bounded LFU with LRU tie-break inside a frequency class (the
@@ -146,6 +151,12 @@ class LFUCache:
 
     def items(self):
         return self._vals.items()
+
+    def eviction_order(self):
+        """Keys in the order the policy would evict them (ascending
+        frequency, LRU inside each class). Snapshot before mutating."""
+        for f in sorted(self._buckets):
+            yield from self._buckets[f].keys()
 
 
 _CACHE_POLICIES = {"lru": LRUCache, "lfu": LFUCache}

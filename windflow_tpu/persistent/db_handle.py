@@ -66,8 +66,27 @@ class DBHandle:
             "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
             (self._kbytes(key), self._ser(value)))
 
+    def put_many(self, items) -> None:
+        """Batched upsert (one executemany) — the tiered cold store's
+        demote path writes whole victim batches, never one row at a
+        time."""
+        self._conn.executemany(
+            "INSERT INTO kv (k, v) VALUES (?, ?) "
+            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+            [(self._kbytes(k), self._ser(v)) for k, v in items])
+
     def delete(self, key: Any) -> None:
         self._conn.execute("DELETE FROM kv WHERE k = ?", (self._kbytes(key),))
+
+    def delete_many(self, keys) -> None:
+        self._conn.executemany("DELETE FROM kv WHERE k = ?",
+                               [(self._kbytes(k),) for k in keys])
+
+    def clear(self) -> None:
+        """Drop every row (a fresh owner claiming a reused db path must
+        not inherit a previous run's state)."""
+        self._conn.execute("DELETE FROM kv")
+        self._conn.commit()
 
     def contains(self, key: Any) -> bool:
         return self._conn.execute("SELECT 1 FROM kv WHERE k = ?",

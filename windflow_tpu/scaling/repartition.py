@@ -125,8 +125,29 @@ def _split_scan(scans: List[Optional[dict]], new_n: int,
                 dest: Callable[[Any], int], op_name: str) -> List[dict]:
     """Grid-scan keyed state tables: ``{"slot_of_key", "table_capacity",
     "table"}`` with table a pytree of host arrays whose axis 0 is the
-    slot. Re-bucket keys, then gather each new replica's rows."""
+    slot. Re-bucket keys, then gather each new replica's rows.
+
+    Tiered blobs (a ``"tier"`` sub-dict per source) split across BOTH
+    tiers: cold rows re-bucket by the same dest function, and a
+    destination whose re-bucketed hot set overflows its (unchanged)
+    ``hot_capacity`` spills its coldest keys — ranked by the
+    checkpointed eviction order — into its own cold tier."""
     import numpy as np
+
+    tiers = [st.get("tier") if st else None for st in scans]
+    tiered = any(t is not None for t in tiers)
+    proto_tier = next((t for t in tiers if t is not None), None)
+    rank: Dict[Tuple[int, Any], int] = {}
+    cold_per_dest: List[list] = [[] for _ in range(new_n)]
+    if tiered:
+        from ..state.tiered import cold_items_from_image
+        for si, t in enumerate(tiers):
+            if not t:
+                continue
+            for pos, k in enumerate(t.get("order", [])):
+                rank[(si, k)] = pos  # higher = hotter (evicted later)
+            for key, row in cold_items_from_image(t["cold_image"]):
+                cold_per_dest[dest(key)].append((key, row))
 
     # (key, source index, source slot) in deterministic order
     per_dest: List[List[Tuple[Any, int, int]]] = [[] for _ in range(new_n)]
@@ -138,10 +159,18 @@ def _split_scan(scans: List[Optional[dict]], new_n: int,
     outs = []
     for j in range(new_n):
         sel = per_dest[j]
+        spill: List[Tuple[Any, int, int]] = []
+        if tiered:
+            cap = int(proto_tier["hot_capacity"])
+            # coldest-first; the kept tail is the destination's hot set
+            sel = sorted(sel, key=lambda e: rank.get((e[1], e[0]), -1))
+            n_spill = max(0, len(sel) - cap)
+            spill, sel = sel[:n_spill], sel[n_spill:]
+        else:
+            cap = 64
+            while cap < len(sel):
+                cap *= 2
         slot_of_key = {key: i for i, (key, _, _) in enumerate(sel)}
-        cap = 64
-        while cap < len(sel):
-            cap *= 2
         table = None
         src = next((st for st in scans if st and st.get("table") is not None),
                    None)
@@ -154,21 +183,41 @@ def _split_scan(scans: List[Optional[dict]], new_n: int,
                 src_leaves.append(
                     None if not st or st.get("table") is None
                     else jax.tree_util.tree_leaves(st["table"]))
+
+            def _src_row(li, si, slot):
+                if src_leaves[si] is None:
+                    raise WindFlowError(
+                        f"repartition: {op_name!r} replica {si} "
+                        "registered keys but checkpointed no state "
+                        "table")
+                return np.asarray(src_leaves[si][li])[slot]
+
             new_leaves = []
             for li, proto in enumerate(leaves):
                 proto = np.asarray(proto)
                 out = np.zeros((cap,) + proto.shape[1:], dtype=proto.dtype)
                 for i, (_, si, slot) in enumerate(sel):
-                    if src_leaves[si] is None:
-                        raise WindFlowError(
-                            f"repartition: {op_name!r} replica {si} "
-                            "registered keys but checkpointed no state "
-                            "table")
-                    out[i] = np.asarray(src_leaves[si][li])[slot]
+                    out[i] = _src_row(li, si, slot)
                 new_leaves.append(out)
             table = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        outs.append({"slot_of_key": slot_of_key, "table_capacity": cap,
-                     "table": table})
+            for key, si, slot in spill:  # overflow hot rows -> dest cold
+                cold_per_dest[j].append((key, tuple(
+                    _src_row(li, si, slot) for li in range(len(leaves)))))
+        elif spill:
+            raise WindFlowError(
+                f"repartition: {op_name!r} holds tiered keys but "
+                "checkpointed no state table to spill rows from")
+        blob = {"slot_of_key": slot_of_key, "table_capacity": cap,
+                "table": table}
+        if tiered:
+            from ..state.tiered import build_tier_blob, hot_table_digest
+            blob["tier"] = build_tier_blob(
+                proto_tier["policy"], cap,
+                free_slots=range(cap - 1, len(sel) - 1, -1),
+                order=[key for key, _, _ in sel],  # coldest-first kept
+                cold_items=cold_per_dest[j],
+                hot_digest=hot_table_digest(table))
+        outs.append(blob)
     return outs
 
 

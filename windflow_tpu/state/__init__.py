@@ -1,0 +1,17 @@
+"""Tiered keyed-state plane (hot device tier + host cold tier).
+
+``TieredKeyStore`` fronts the dense device key tables of the stateful
+grid-scan operators (single-chip and mesh) with a host-side sqlite cold
+store, making key capacity elastic: the device table holds only the
+policy-selected hot set, the cold tail spills to the host, and batches
+whose keys fall outside the hot set trigger BATCHED promote/demote
+slot-row transfers — never per-key device traffic. Enabled with
+``with_tiering(policy, hot_capacity)`` on the stateful TPU/mesh
+builders; the dense path is byte-identical when tiering is off.
+"""
+
+from .tiered import (ColdStore, TierConfig, TieredKeyStore, TierPlan,
+                     cold_image_from_items, cold_items_from_image)
+
+__all__ = ["ColdStore", "TierConfig", "TieredKeyStore", "TierPlan",
+           "cold_image_from_items", "cold_items_from_image"]

@@ -1,0 +1,474 @@
+"""TieredKeyStore: hot keys device-resident, cold tail host-spilled.
+
+Every keyed device structure in the TPU plane is dense and padded: key
+cardinality is a build-time constant capped by device memory. The real
+shape of keyed traffic (the ``--replay`` bench's Zipf streams) is a
+small hot set and a huge cold tail, so this module splits the key space
+into two tiers:
+
+- **hot tier**: the existing dense device table, capped at
+  ``hot_capacity`` slots. Slots are recycled — the KeySlotMap maps only
+  the currently-hot keys, demoted keys release their slot to a free
+  list.
+- **cold tier**: a host-side sqlite store (``ColdStore`` over the
+  ``persistent.db_handle.DBHandle`` machinery) holding one row of state
+  leaves per demoted key.
+
+The policy deciding WHICH keys stay hot is the existing
+``persistent.cache`` machinery (``policy="lru"|"lfu"`` via
+``make_cache``), used as a pure recency/frequency tracker: victims come
+from ``eviction_order()``, never from implicit auto-eviction, so the
+tracker can never disagree with the slot map.
+
+Movement between tiers is planned per BATCH and applied as vectorized
+slot-row transfers (one gather + one scatter per batch, riding the
+replica's ``DeviceDispatchQueue``), never per-key device_put calls —
+``plan_batch`` returns a ``TierPlan`` naming the promoted keys with
+their assigned slots and the demoted victims with the slots they free.
+
+The overload governor's TUNE rung can shrink ``target_hot_capacity``
+under memory pressure (restored on release); the next ``plan_batch``
+then demotes down to the target before admitting new keys.
+
+Env knobs: ``WF_TIER_DB_DIR`` (cold-store directory; defaults to the
+``WF_DB_DIR`` scheme), ``WF_TIER_POLICY`` (default eviction policy when
+``with_tiering`` is called without one), ``WF_TIER_MIN_HOT`` (floor the
+governor's shrink lever cannot cross, default 64).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import sqlite3
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..basic import KeyCapacityError, WindFlowError
+from ..persistent.cache import make_cache
+from ..persistent.db_handle import DBHandle
+
+
+def _tier_db_dir() -> Optional[str]:
+    return os.environ.get("WF_TIER_DB_DIR") or None
+
+
+def default_tier_policy() -> str:
+    return os.environ.get("WF_TIER_POLICY", "lru").strip().lower()
+
+
+def tier_min_hot() -> int:
+    try:
+        return max(1, int(os.environ.get("WF_TIER_MIN_HOT", "64")))
+    except ValueError:
+        return 64
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def hot_table_digest(table) -> Optional[str]:
+    """Canonical digest of a HOST-side state-table pytree: dtype + shape
+    + raw bytes per leaf, in tree order. Deterministic across checkpoint
+    round-trips (pickle bytes are not guaranteed to be), so the manifest
+    can pin the hot tier independently of the cold image."""
+    if table is None:
+        return None
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(table):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(a.dtype.str.encode())
+        h.update(np.asarray(a.shape, dtype=np.int64).tobytes())
+        h.update(a.tobytes())
+    return "sha256:" + h.hexdigest()
+
+
+class TierConfig:
+    """Builder-side tiering declaration (``with_tiering``), attached to
+    the operator and consumed by its replicas' engines."""
+
+    __slots__ = ("policy", "hot_capacity", "db_dir")
+
+    def __init__(self, policy: Optional[str] = None,
+                 hot_capacity: int = 1024,
+                 db_dir: Optional[str] = None) -> None:
+        from ..persistent.cache import _CACHE_POLICIES
+        self.policy = (policy or default_tier_policy()).lower()
+        if self.policy not in _CACHE_POLICIES:
+            raise WindFlowError(
+                f"with_tiering: unknown eviction policy {policy!r} "
+                f"(expected one of {sorted(_CACHE_POLICIES)})")
+        self.hot_capacity = int(hot_capacity)
+        if self.hot_capacity < 1:
+            raise WindFlowError("with_tiering: hot_capacity must be >= 1")
+        self.db_dir = db_dir or _tier_db_dir()
+
+
+class TierPlan:
+    """One batch's tier maintenance: keys to promote (cold -> their
+    assigned hot slots) and victims to demote (hot slots -> cold).
+    Applied by the ENGINE as one slot-row gather + one scatter."""
+
+    __slots__ = ("promote_keys", "promote_slots", "demote_keys",
+                 "demote_slots")
+
+    def __init__(self, promote_keys: List[Any], promote_slots: np.ndarray,
+                 demote_keys: List[Any], demote_slots: np.ndarray) -> None:
+        self.promote_keys = promote_keys
+        self.promote_slots = promote_slots
+        self.demote_keys = demote_keys
+        self.demote_slots = demote_slots
+
+
+class ColdStore:
+    """Host-side cold tier: one sqlite row per demoted key, the value a
+    tuple of the key's state LEAVES (the flattened state pytree row).
+    Built on ``DBHandle`` so checkpointing reuses the sqlite online-
+    backup image (``snapshot_bytes``/``restore_bytes``) unchanged."""
+
+    def __init__(self, name: str, db_dir: Optional[str] = None,
+                 fresh: bool = False) -> None:
+        self.db = DBHandle(name, db_dir=db_dir)
+        if fresh:
+            # a NEW engine claiming this path starts empty: stale rows
+            # from a crashed run must only come back via restore_bytes
+            self.db.clear()
+        # cached row count — gauges read len() every batch and a sqlite
+        # COUNT(*) there is measurable; tier ownership is exclusive
+        # (a demoted key is never already cold), so put/take deltas keep
+        # the cache exact. None = unknown, recomputed lazily.
+        self._count: Optional[int] = 0 if fresh else None
+
+    def put_rows(self, keys: List[Any], leaf_cols: List[np.ndarray]) -> None:
+        """Batched demote write: ``leaf_cols[l][i]`` is leaf ``l`` of
+        ``keys[i]``'s state row. One executemany, not one put per key;
+        committed per batch so the connection never pins a write lock
+        across batches."""
+        if not keys:
+            return
+        self.db.put_many(
+            (k, tuple(col[i] for col in leaf_cols))
+            for i, k in enumerate(keys))
+        self.db._conn.commit()
+        if self._count is not None:
+            self._count += len(keys)
+
+    def take_rows(self, keys: List[Any],
+                  default_leaves: List[Any],
+                  leaf_dtypes: List[Any]) -> Tuple[List[np.ndarray], int]:
+        """Batched promote read: per-leaf ``(len(keys),)`` columns, rows
+        of keys the cold tier never saw filled from the initial state
+        (a brand-new key IS a cold miss on nothing). Taken rows are
+        deleted — promotion moves ownership to the hot tier. Returns
+        ``(leaf_cols, n_cold_hits)``."""
+        n = len(keys)
+        cols = [np.full((n,), default_leaves[li], dtype=leaf_dtypes[li])
+                for li in range(len(default_leaves))]
+        hits = 0
+        taken = []
+        for i, k in enumerate(keys):
+            row = self.db.get(k)
+            if row is None:
+                continue
+            hits += 1
+            taken.append(k)
+            for li, v in enumerate(row):
+                cols[li][i] = v
+        if taken:
+            self.db.delete_many(taken)
+            if self._count is not None:
+                self._count -= len(taken)
+        return cols, hits
+
+    def __len__(self) -> int:
+        if self._count is None:
+            self._count = len(self.db)
+        return self._count
+
+    def clear(self) -> None:
+        self.db.clear()
+        self._count = 0
+
+    def keys(self):
+        return self.db.keys()
+
+    def items(self):
+        return self.db.items()
+
+    def snapshot_bytes(self) -> bytes:
+        return self.db.snapshot_bytes()
+
+    def restore_bytes(self, data: bytes) -> None:
+        self.db.restore_bytes(data)
+        self._count = None
+
+    def close(self) -> None:
+        self.db.close()
+
+
+# -- checkpoint-image helpers (repartitioner / tests) -----------------------
+def cold_items_from_image(data: bytes) -> List[Tuple[Any, Any]]:
+    """Decode a ``ColdStore`` sqlite online-backup image into
+    ``(key, leaf-tuple)`` items without touching any live store — the
+    repartitioner re-buckets cold keys from checkpoint blobs."""
+    import pickle
+    fd, tmp = tempfile.mkstemp(suffix=".tierimg")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        conn = sqlite3.connect(tmp)
+        try:
+            return [(pickle.loads(k), pickle.loads(v))
+                    for k, v in conn.execute("SELECT k, v FROM kv")]
+        finally:
+            conn.close()
+    finally:
+        os.unlink(tmp)
+
+
+def build_tier_blob(policy: str, hot_capacity: int, free_slots,
+                    order, cold_items,
+                    hot_digest: Optional[str] = None) -> dict:
+    """Assemble a tier checkpoint sub-blob from parts — the
+    repartitioner re-buckets hot and cold keys across destinations and
+    needs blobs ``TieredKeyStore.restore`` accepts (per-tier digests
+    included)."""
+    image = cold_image_from_items(cold_items)
+    d = {"policy": policy, "hot_capacity": int(hot_capacity),
+         "free_slots": [int(s) for s in free_slots],
+         "order": list(order),
+         "cold_image": image,
+         "digests": {"cold": _digest(image)}}
+    if hot_digest is not None:
+        d["digests"]["hot"] = hot_digest
+    return d
+
+
+def cold_image_from_items(items) -> bytes:
+    """Inverse of ``cold_items_from_image``: build a fresh ColdStore
+    image holding ``items`` (the repartitioner's per-destination cold
+    buckets)."""
+    import pickle
+    fd, tmp = tempfile.mkstemp(suffix=".tierimg")
+    os.close(fd)
+    try:
+        conn = sqlite3.connect(tmp)
+        try:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)")
+            conn.executemany(
+                "INSERT INTO kv (k, v) VALUES (?, ?)",
+                [(pickle.dumps(k), pickle.dumps(v)) for k, v in items])
+            conn.commit()
+        finally:
+            conn.close()
+        with open(tmp, "rb") as f:
+            return f.read()
+    finally:
+        os.unlink(tmp)
+
+
+# distinguishes cold-store db files of same-named engines (rebuilt
+# graphs in one process would otherwise contend for one sqlite file)
+_store_seq = itertools.count()
+
+
+class TieredKeyStore:
+    """The tier control plane of ONE keyed engine: slot free list, the
+    eviction-policy tracker, the cold store, and the per-batch planner.
+    The engine owns the device table and applies the returned plans; the
+    store never touches device memory itself (so one implementation
+    serves both the single-chip grid scan and the mesh plane)."""
+
+    def __init__(self, name: str, config: TierConfig,
+                 stats=None) -> None:
+        self.name = name
+        self.policy = config.policy
+        self.hot_capacity = int(config.hot_capacity)
+        # governor TUNE lever: shrink target under memory pressure,
+        # restored on release; plan_batch demotes down to it lazily
+        self.target_hot_capacity = self.hot_capacity
+        self.min_hot = tier_min_hot()
+        # pure eviction-order tracker: capacity far above hot_capacity so
+        # the cache NEVER auto-evicts — victims come only from plan_batch,
+        # keeping tracker and slot map in lockstep
+        self.tracker = make_cache(self.policy, 1 << 62)
+        self.cold = ColdStore(f"{name}_{next(_store_seq)}",
+                              db_dir=config.db_dir, fresh=True)
+        self.free_slots: List[int] = list(range(self.hot_capacity - 1,
+                                                -1, -1))
+        self.stats = stats
+        # batching observability: tests assert promoted keys >> scatter
+        # calls (no per-key device traffic)
+        self.promote_batches = 0
+        self.demote_batches = 0
+        self.promoted_keys = 0
+        self.demoted_keys = 0
+        self.lookups = 0
+        self.misses = 0
+
+    # -- per-batch planning ------------------------------------------------
+    def plan_batch(self, keymap, batch_keys: List[Any]
+                   ) -> Optional[TierPlan]:
+        """Plan tier maintenance for one batch's DISTINCT keys: touch the
+        policy for hot hits, pick victims for the misses (never a key of
+        this batch), and assign recycled slots to the promotions.
+        Mutates the keymap (evict/assign) so the subsequent vectorized
+        ``slots_of`` resolves every key without on_new. Returns None in
+        steady state (all keys hot, no shrink pending)."""
+        sk = keymap.slot_of_key
+        tr = self.tracker
+        missing: List[Any] = []
+        for k in batch_keys:
+            if k in sk:
+                tr.get(k)
+            else:
+                missing.append(k)
+        self.lookups += len(batch_keys)
+        self.misses += len(missing)
+        eff_cap = min(self.hot_capacity,
+                      max(self.min_hot, int(self.target_hot_capacity)))
+        if len(batch_keys) > self.hot_capacity:
+            raise KeyCapacityError(
+                self.name, self.hot_capacity,
+                len(batch_keys) - self.hot_capacity,
+                hint="one batch touches more distinct keys than the hot "
+                     "tier holds; raise with_tiering(hot_capacity=) above "
+                     "the per-batch working set")
+        # a governor-shrunk target never blocks a batch the PHYSICAL
+        # tier can hold — shrinking resumes once working sets allow it
+        eff_cap = max(eff_cap, len(batch_keys))
+        n_evict = max(0, len(sk) + len(missing) - eff_cap)
+        if not missing and not n_evict:
+            # steady state (every key hot, no shrink pending): skip the
+            # victim scan and empty-array plumbing — this path runs once
+            # per batch on the dispatch thread
+            return None
+        demote_keys: List[Any] = []
+        if n_evict:
+            batch_set = set(batch_keys)
+            for k in list(tr.eviction_order()):
+                if k in batch_set:
+                    continue
+                demote_keys.append(k)
+                if len(demote_keys) == n_evict:
+                    break
+            if len(demote_keys) < n_evict:  # pragma: no cover - guarded
+                raise KeyCapacityError(self.name, eff_cap,
+                                       n_evict - len(demote_keys))
+        demote_slots = np.asarray([sk[k] for k in demote_keys],
+                                  dtype=np.int64)
+        for k in demote_keys:
+            tr.pop(k)
+            keymap.evict(k)
+        self.free_slots.extend(int(s) for s in demote_slots)
+        promote_slots = np.asarray(
+            [self.free_slots.pop() for _ in missing], dtype=np.int64)
+        for k, s in zip(missing, promote_slots):
+            keymap.assign(k, int(s))
+            tr.put(k, True)
+        if not missing and not demote_keys:
+            return None
+        return TierPlan(missing, promote_slots, demote_keys, demote_slots)
+
+    # -- accounting hooks (engines call these around the data movement) ----
+    def note_demote(self, n_keys: int) -> None:
+        self.demote_batches += 1
+        self.demoted_keys += n_keys
+        if self.stats is not None:
+            self.stats.note_tier_demote(n_keys)
+
+    def note_promote(self, n_keys: int, usec: float) -> None:
+        self.promote_batches += 1
+        self.promoted_keys += n_keys
+        if self.stats is not None:
+            self.stats.note_tier_promote(n_keys, usec)
+
+    def publish_gauges(self, n_hot: int) -> None:
+        if self.stats is not None:
+            self.stats.note_tier_gauges(n_hot, len(self.cold),
+                                        self.lookups, self.misses)
+
+    def adopt_dense(self, slot_of_key: Dict[Any, int]) -> None:
+        """Rebuild the tier bookkeeping from a DENSE checkpoint's key
+        map (a pre-tiering blob restored into a tiered graph): every
+        checkpointed key becomes hot at its dense slot, the cold tier
+        starts empty, recency order = slot order. Refuses when the dense
+        key count exceeds the hot tier."""
+        n = len(slot_of_key)
+        if n > self.hot_capacity:
+            raise KeyCapacityError(
+                self.name, self.hot_capacity, n - self.hot_capacity,
+                hint="dense checkpoint holds more keys than the hot "
+                     "tier; raise with_tiering(hot_capacity=) or restore "
+                     "into a graph without tiering")
+        used = set(int(s) for s in slot_of_key.values())
+        self.free_slots = [s for s in range(self.hot_capacity - 1, -1, -1)
+                           if s not in used]
+        self.tracker = make_cache(self.policy, 1 << 62)
+        for k, _s in sorted(slot_of_key.items(), key=lambda kv: kv[1]):
+            self.tracker.put(k, True)
+        self.cold.clear()
+        self.target_hot_capacity = self.hot_capacity
+
+    # -- checkpoint plane --------------------------------------------------
+    def snapshot(self, hot_digest: Optional[str] = None) -> dict:
+        """The tier's checkpoint sub-blob: policy + capacities, the slot
+        free list, the tracker's eviction order, and the cold tier as
+        the sqlite online-backup image — with PER-TIER digests recorded
+        alongside (the manifest's blob digest covers the whole blob;
+        these pin each tier individually so a torn cold image is named
+        as such on restore)."""
+        image = self.cold.snapshot_bytes()
+        d = {
+            "policy": self.policy,
+            "hot_capacity": self.hot_capacity,
+            "free_slots": list(self.free_slots),
+            "order": list(self.tracker.eviction_order()),
+            "cold_image": image,
+            "digests": {"cold": _digest(image)},
+        }
+        if hot_digest is not None:
+            d["digests"]["hot"] = hot_digest
+        return d
+
+    def restore(self, d: dict, hot_digest: Optional[str] = None) -> None:
+        if int(d.get("hot_capacity", self.hot_capacity)) \
+                != self.hot_capacity:
+            raise WindFlowError(
+                f"{self.name}: tiered restore holds hot_capacity="
+                f"{d.get('hot_capacity')} but this graph declares "
+                f"hot_capacity={self.hot_capacity}; restore with the "
+                "checkpointed capacity (slot ids are positions in the "
+                "hot table)")
+        digests = d.get("digests") or {}
+        image = d.get("cold_image")
+        if image is not None:
+            want = digests.get("cold")
+            if want and _digest(image) != want:
+                from ..checkpoint.store import CorruptCheckpointError
+                raise CorruptCheckpointError(
+                    f"{self.name}: cold-tier image digest mismatch "
+                    f"(expected {want})")
+            self.cold.restore_bytes(image)
+        if hot_digest is not None and digests.get("hot") \
+                and hot_digest != digests["hot"]:
+            from ..checkpoint.store import CorruptCheckpointError
+            raise CorruptCheckpointError(
+                f"{self.name}: hot-tier table digest mismatch "
+                f"(expected {digests['hot']}, got {hot_digest})")
+        self.free_slots = [int(s) for s in d.get("free_slots", [])]
+        # rebuild the tracker in checkpointed eviction order (LRU order
+        # survives exactly; LFU frequencies reset to 1 — recency inside
+        # the rebuilt order still breaks ties the same way)
+        self.tracker = make_cache(self.policy, 1 << 62)
+        for k in d.get("order", []):
+            self.tracker.put(k, True)
+        self.target_hot_capacity = self.hot_capacity

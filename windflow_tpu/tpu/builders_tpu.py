@@ -22,6 +22,34 @@ class _TPUBuilderMixin:
         return self
 
 
+class _TieredStateMixin:
+    """``with_tiering`` for the keyed-state operators: cap the device
+    table at ``hot_capacity`` slots and spill the cold key tail to a
+    host sqlite store (``windflow_tpu.state``). Key capacity becomes
+    elastic — bounded by host disk, not device memory — while batches
+    over the hot set run the unchanged dense path."""
+
+    _tiering = None
+
+    def with_tiering(self, policy: Optional[str] = None,
+                     hot_capacity: int = 1024,
+                     db_dir: Optional[str] = None):
+        """Enable the hot/cold key tiers. ``policy`` picks the eviction
+        order ("lru" | "lfu"; default ``WF_TIER_POLICY`` or "lru"),
+        ``hot_capacity`` the device-resident slot count — it must exceed
+        every batch's distinct-key working set (a single batch touching
+        more keys than the hot tier holds raises ``KeyCapacityError``)."""
+        from ..state.tiered import TierConfig
+        self._tiering = TierConfig(policy=policy, hot_capacity=hot_capacity,
+                                   db_dir=db_dir)
+        return self
+
+    def _tiering_guard(self, what: str) -> None:
+        if self._tiering is not None and self._state_init is None:
+            raise WindFlowError(f"{what}: with_tiering requires with_state "
+                                "(tiers hold the keyed device state)")
+
+
 class _MeshBuilderMixin:
     """``with_mesh`` for the keyed device operators: shard the operator's
     keyed-state plane over a ``('key','data')`` device mesh
@@ -64,7 +92,8 @@ class _MeshBuilderMixin:
                                 "(the mesh shards the KEYED plane)")
 
 
-class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin, _MeshBuilderMixin):
+class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin, _MeshBuilderMixin,
+                      _TieredStateMixin):
     _default_name = "map_tpu"
 
     def __init__(self, func: Callable) -> None:
@@ -82,21 +111,23 @@ class Map_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin, _MeshBuilderMixin):
         if self._state_init is not None and self._key_extractor is None:
             raise WindFlowError("Map_TPU_Builder: with_state requires "
                                 "with_key_by")
+        self._tiering_guard("Map_TPU_Builder")
         if self._mesh_cfg is not None:
             from ..mesh.ops_mesh import Map_Mesh
             self._mesh_guard("Map_TPU_Builder")
             return self._finish(Map_Mesh(
                 self._func, self._state_init, self._key_extractor,
                 self._name if self._name != self._default_name
-                else "map_mesh", schema=self._schema, **self._mesh_cfg))
+                else "map_mesh", schema=self._schema,
+                tiering=self._tiering, **self._mesh_cfg))
         return self._finish(Map_TPU(self._func, self._name, self._parallelism,
                                     self._routing, self._key_extractor,
                                     self._output_batch_size, self._schema,
-                                    self._state_init))
+                                    self._state_init, self._tiering))
 
 
 class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin,
-                         _MeshBuilderMixin):
+                         _MeshBuilderMixin, _TieredStateMixin):
     _default_name = "filter_tpu"
 
     def __init__(self, pred: Callable) -> None:
@@ -114,18 +145,20 @@ class Filter_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin,
         if self._state_init is not None and self._key_extractor is None:
             raise WindFlowError("Filter_TPU_Builder: with_state requires "
                                 "with_key_by")
+        self._tiering_guard("Filter_TPU_Builder")
         if self._mesh_cfg is not None:
             from ..mesh.ops_mesh import Filter_Mesh
             self._mesh_guard("Filter_TPU_Builder")
             return self._finish(Filter_Mesh(
                 self._func, self._state_init, self._key_extractor,
                 self._name if self._name != self._default_name
-                else "filter_mesh", schema=self._schema, **self._mesh_cfg))
+                else "filter_mesh", schema=self._schema,
+                tiering=self._tiering, **self._mesh_cfg))
         return self._finish(Filter_TPU(self._func, self._name,
                                        self._parallelism, self._routing,
                                        self._key_extractor,
                                        self._output_batch_size, self._schema,
-                                       self._state_init))
+                                       self._state_init, self._tiering))
 
 
 class Reduce_TPU_Builder(_RoutableBuilder, _TPUBuilderMixin,

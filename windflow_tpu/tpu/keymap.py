@@ -27,6 +27,26 @@ def structured_unique(keys_arr: np.ndarray, n: int):
         return None
 
 
+def distinct_batch_keys(keys, keys_arr: np.ndarray, n: int):
+    """The batch's DISTINCT keys in the same canonical hashed form each
+    ``slots_of`` path registers them (python ints for int columns, tuples
+    for structured rows) — the tiered store plans promotions against
+    these before the vectorized slot resolution runs, so every form
+    mismatch would split one stream key into two slots."""
+    if not n:
+        return []
+    if keys_arr.ndim == 1:
+        if keys_arr.dtype.kind in "iu":
+            return [int(k) for k in np.unique(keys_arr[:n])]
+        if keys_arr.dtype.kind == "V" and keys_arr.dtype.names:
+            uu = structured_unique(keys_arr, n)
+            if uu is not None:
+                return [u.item() for u in uu[0]]
+            return list(dict.fromkeys(keys_arr[:n].tolist()))
+    it = iter(keys)
+    return list(dict.fromkeys(next(it) for _ in range(n)))
+
+
 class KeySlotMap:
     LUT_MAX = 1 << 22  # 16 MiB int32 ceiling for the direct table
 
@@ -51,6 +71,28 @@ class KeySlotMap:
                 self._on_new(key, s)
             self.slot_of_key[key] = s
         return s
+
+    # -- tiered-store slot reuse (windflow_tpu.state.tiered) ---------------
+    # The tiered key store recycles slots of demoted keys, so slot ids are
+    # assigned by the TIER plan, not by insertion order; these two keep the
+    # dict and the int LUT consistent under out-of-order assignment.
+    def assign(self, key, slot: int) -> None:
+        """Register ``key`` at an explicit ``slot`` (tier promote)."""
+        self.slot_of_key[key] = slot
+        lut = self._lut
+        if lut is not None and isinstance(key, (int, np.integer)) \
+                and 0 <= key < len(lut):
+            lut[key] = slot
+
+    def evict(self, key) -> None:
+        """Forget ``key`` (tier demote); its slot is the caller's to
+        recycle. The LUT entry must clear too — a stale hit would route
+        the key to a slot now owned by someone else."""
+        self.slot_of_key.pop(key, None)
+        lut = self._lut
+        if lut is not None and isinstance(key, (int, np.integer)) \
+                and 0 <= key < len(lut):
+            lut[key] = -1
 
     def slots_of(self, keys, keys_arr: np.ndarray, n: int) -> np.ndarray:
         """Vectorized mapping of a whole batch; int result of length n

@@ -543,16 +543,20 @@ class Map_TPU(TPUOperatorBase):
                  input_routing: RoutingMode = RoutingMode.FORWARD,
                  key_extractor=None, output_batch_size: int = 0,
                  schema: Optional[TupleSchema] = None,
-                 state_init: Any = None) -> None:
+                 state_init: Any = None, tiering=None) -> None:
         if state_init is not None and key_extractor is None:
             raise WindFlowError(f"{name}: stateful Map_TPU requires a key "
                                 "extractor (KEYBY)")
+        if tiering is not None and state_init is None:
+            raise WindFlowError(f"{name}: with_tiering requires keyed "
+                                "state (with_state)")
         super().__init__(name, parallelism,
                          RoutingMode.KEYBY if state_init is not None
                          else input_routing,
                          key_extractor, output_batch_size, schema)
         self.func = func
         self.state_init = state_init
+        self.tiering = tiering
 
     @property
     def fusion_role(self) -> Optional[str]:
@@ -641,6 +645,18 @@ class _KeyedStateScan:
         self._cache = self.op._scan_prog_cache
         self._cache_lock = self.op._scan_prog_lock
         self.table = None  # pytree of (table_capacity, ...) arrays
+        # tiered keyed state (windflow_tpu.state): with_tiering caps the
+        # device table at hot_capacity and spills the cold tail to a
+        # host sqlite store; None = the dense path, byte-identical to
+        # before the tier plane existed
+        self.tier = None
+        cfg = getattr(self.op, "tiering", None)
+        if cfg is not None:
+            from ..state.tiered import TieredKeyStore
+            self.tier = TieredKeyStore(
+                f"{self.op.name}_r{replica.idx}_tier", cfg,
+                stats=replica.stats)
+            self.table_capacity = self.tier.hot_capacity
 
     # -- device program ----------------------------------------------------
     def _make(self, M: int, KB: int):
@@ -685,6 +701,16 @@ class _KeyedStateScan:
             self.table = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
                                    dtype=jnp.asarray(v).dtype), init)
+        if self.tier is not None:
+            # tiered mode: the device table IS the hot tier, fixed at
+            # hot_capacity — keys beyond it spill to the cold store via
+            # plan_batch, which guarantees the mapped set always fits
+            if n_keys_needed > self.table_capacity:  # pragma: no cover
+                from ..basic import KeyCapacityError
+                raise KeyCapacityError(
+                    self.op.name, self.table_capacity,
+                    n_keys_needed - self.table_capacity)
+            return
         if n_keys_needed > self.table_capacity:
             # growth reads the CURRENT table: in-flight commits reassign
             # it (donation), so they must land first
@@ -712,6 +738,13 @@ class _KeyedStateScan:
         n = batch.size
         cap = batch.capacity
         keys, keys_arr = op_batch_keys_np(self.op, batch)
+        if self.tier is not None and n:
+            from .keymap import distinct_batch_keys
+            plan = self.tier.plan_batch(
+                self._keymap, distinct_batch_keys(keys, keys_arr, n))
+            if plan is not None:
+                self._submit_tier_plan(plan)
+            self.tier.publish_gauges(len(self.slot_of_key))
         gslots = self._keymap.slots_of(keys, keys_arr, n)
         self._ensure_table(len(self.slot_of_key))
         if self.table_capacity <= 4 * max(1, n):
@@ -745,6 +778,44 @@ class _KeyedStateScan:
         return cached_compile(self._cache, self._cache_lock, (M, KB),
                               lambda: self._make(M, KB))
 
+    # -- tiered data movement ----------------------------------------------
+    def _submit_tier_plan(self, plan) -> None:
+        """Queue one batch's tier maintenance on the replica's dispatch
+        queue: ``handle_msg`` submits the batch's own commit AFTER prep
+        returns, so this lands behind every in-flight commit and ahead of
+        the batch that needs the promoted rows. The movement itself is
+        batched — ONE slot-row gather per leaf for the demotes, ONE
+        scatter per leaf for the promotes — never per-key transfers."""
+        import jax
+        import jax.numpy as jnp
+
+        tier = self.tier
+
+        def tier_commit() -> None:
+            import jax.numpy as jnp  # local: commit may run on drain
+            self._ensure_table(0)  # first batch: allocate the hot tier
+            t0 = time.perf_counter()
+            leaves, treedef = jax.tree_util.tree_flatten(self.table)
+            if len(plan.demote_keys):
+                dslots = jnp.asarray(plan.demote_slots)
+                cols = [np.asarray(jax.device_get(lf[dslots]))
+                        for lf in leaves]
+                tier.cold.put_rows(plan.demote_keys, cols)
+                tier.note_demote(len(plan.demote_keys))
+            if len(plan.promote_keys):
+                init_leaves = jax.tree_util.tree_leaves(self.state_init)
+                cols, _hits = tier.cold.take_rows(
+                    plan.promote_keys, init_leaves,
+                    [np.dtype(lf.dtype) for lf in leaves])
+                pslots = jnp.asarray(plan.promote_slots)
+                leaves = [lf.at[pslots].set(jnp.asarray(col))
+                          for lf, col in zip(leaves, cols)]
+                self.table = jax.tree_util.tree_unflatten(treedef, leaves)
+                tier.note_promote(len(plan.promote_keys),
+                                  (time.perf_counter() - t0) * 1e6)
+
+        self.replica.dispatch.submit(tier_commit, 0.0)
+
     # -- checkpointing -----------------------------------------------------
     # The whole scan state is (key -> slot dict, capacity, one device
     # pytree): device_get it to host numpy for the blob (DrJAX-style —
@@ -753,22 +824,70 @@ class _KeyedStateScan:
     # from the restored dict, and compiled programs re-trace on demand.
     def snapshot_state(self) -> dict:
         import jax
-        return {"slot_of_key": dict(self.slot_of_key),
-                "table_capacity": self.table_capacity,
-                "table": (None if self.table is None
-                          else jax.device_get(self.table))}
+        table = (None if self.table is None
+                 else jax.device_get(self.table))
+        d = {"slot_of_key": dict(self.slot_of_key),
+             "table_capacity": self.table_capacity,
+             "table": table}
+        if self.tier is not None:
+            from ..state.tiered import hot_table_digest
+            d["tier"] = self.tier.snapshot(
+                hot_digest=hot_table_digest(table))
+        return d
 
     def restore_state(self, state: dict) -> None:
         import jax
 
+        tier_blob = state.get("tier")
+        if tier_blob is not None and self.tier is None:
+            raise WindFlowError(
+                f"{self.op.name}: checkpoint holds a TIERED key store "
+                "(hot + cold) but this graph was built without "
+                "with_tiering(); cold-tier keys cannot be restored into "
+                "a dense table — rebuild the graph with tiering enabled")
         self.slot_of_key.clear()  # shared alias with the KeySlotMap
         self.slot_of_key.update(state.get("slot_of_key", {}))
         self._keymap._lut = None
+        table = state.get("table")
+        if self.tier is not None:
+            if tier_blob is not None:
+                from ..state.tiered import hot_table_digest
+                self.tier.restore(tier_blob,
+                                  hot_digest=hot_table_digest(table))
+                self.table_capacity = self.tier.hot_capacity
+                self.table = (None if table is None else
+                              jax.tree_util.tree_map(jax.device_put,
+                                                     table))
+            else:
+                # dense (pre-tiering) blob into a tiered engine: every
+                # checkpointed key becomes hot — dense slot ids are
+                # contiguous from 0 so they are valid hot slots iff the
+                # key count fits (adopt_dense refuses otherwise)
+                self._adopt_dense_blob(table)
+            return
         self.table_capacity = state.get("table_capacity",
                                         self.table_capacity)
-        table = state.get("table")
         self.table = (None if table is None
                       else jax.tree_util.tree_map(jax.device_put, table))
+
+    def _adopt_dense_blob(self, table) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.tier.adopt_dense(self.slot_of_key)
+        cap = self.tier.hot_capacity
+        self.table_capacity = cap
+        if table is None:
+            self.table = None
+            return
+        # refit the dense table to the hot tier's shape: occupied rows
+        # carry over (all slots < key count <= cap), padding rows start
+        # from the initial state
+        self.table = jax.tree_util.tree_map(
+            lambda v, a: jnp.full((cap,), v, dtype=np.asarray(a).dtype)
+                            .at[:min(cap, len(a))]
+                            .set(jnp.asarray(np.asarray(a)[:cap])),
+            self.state_init, table)
 
 
 class StatefulMapTPUReplica(TPUReplicaBase):
@@ -854,16 +973,20 @@ class Filter_TPU(TPUOperatorBase):
                  input_routing: RoutingMode = RoutingMode.FORWARD,
                  key_extractor=None, output_batch_size: int = 0,
                  schema: Optional[TupleSchema] = None,
-                 state_init: Any = None) -> None:
+                 state_init: Any = None, tiering=None) -> None:
         if state_init is not None and key_extractor is None:
             raise WindFlowError(f"{name}: stateful Filter_TPU requires a "
                                 "key extractor (KEYBY)")
+        if tiering is not None and state_init is None:
+            raise WindFlowError(f"{name}: with_tiering requires keyed "
+                                "state (with_state)")
         super().__init__(name, parallelism,
                          RoutingMode.KEYBY if state_init is not None
                          else input_routing,
                          key_extractor, output_batch_size, schema)
         self.pred = pred
         self.state_init = state_init
+        self.tiering = tiering
 
     @property
     def fusion_role(self) -> Optional[str]:
