@@ -1316,6 +1316,113 @@ def _replay_mode() -> None:
             "tier_miss_rate": rep.get("Tier_miss_rate", 0.0),
         }
 
+    def run_delta() -> dict:
+        """Incremental-checkpoint leg: Zipf-1.1 traffic through a DENSE
+        stateful device scan with ``WF_CKPT_DELTA``/``WF_CKPT_ASYNC`` on
+        and commit-waited checkpoints. A preload pass registers the full
+        key space (fixing the table capacity, so every later epoch is
+        delta-eligible); each epoch then snapshots only the rows the
+        heavy-tail traffic touched since the last full base. Records
+        ``ckpt_delta_bytes_ratio`` — per-epoch delta bytes over
+        per-epoch full-base bytes."""
+        try:
+            from windflow_tpu.tpu import Map_TPU_Builder
+        except Exception as e:  # device plane absent: report, don't fail
+            return {"skipped": f"device plane unavailable: {e}"}
+        from windflow_tpu.checkpoint import CheckpointStore
+
+        key_space = int(os.environ.get("WF_REPLAY_DELTA_KEYS", "4096"))
+        n = int(os.environ.get("WF_REPLAY_DELTA_TUPLES", "40000"))
+        skew = float(os.environ.get("WF_REPLAY_DELTA_SKEW", "1.5"))
+        epoch_every, batch = 8_000, 512
+        store = tempfile.mkdtemp(prefix="wf_replay_delta_")
+        drng = np.random.default_rng(11)
+        # steeper skew than the tiered leg: the delta plane's payoff is
+        # the change RATE, so the leg models a hot working set over a
+        # large registered key space (zipf 1.1 folded into 4k keys
+        # touches nearly every key each epoch — deltas degenerate to
+        # full size there by construction)
+        keys = (drng.zipf(skew, size=n) - 1) % key_space
+        vals = np.arange(n, dtype=np.float64)
+
+        class DeltaSource:
+            def __init__(self):
+                self.pos = 0
+
+            def __call__(self, shipper):
+                st = CheckpointStore(store)
+                for k in range(key_space):  # register every key
+                    shipper.push({"k": k, "v": 0.0})
+                for i in range(n):
+                    shipper.push({"k": int(keys[i]), "v": float(vals[i])})
+                    self.pos = i + 1
+                    if self.pos % epoch_every == 0:
+                        before = st.latest() or 0
+                        shipper.request_checkpoint()
+                        deadline = time.time() + 30
+                        while (st.latest() or 0) <= before \
+                                and time.time() < deadline:
+                            time.sleep(0.002)
+
+            def snapshot_position(self):
+                return self.pos
+
+            def restore(self, pos):
+                self.pos = pos
+
+        src = DeltaSource()
+        g = PipeGraph("replay_delta", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        g.with_checkpointing(store_dir=store)
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(batch).build()) \
+         .add(Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_name("scan").build()) \
+         .add_sink(Sink_Builder(lambda t: None).with_name("snk").build())
+        old = {k: os.environ.get(k)
+               for k in ("WF_CKPT_DELTA", "WF_CKPT_ASYNC")}
+        os.environ["WF_CKPT_DELTA"] = "1"
+        os.environ["WF_CKPT_ASYNC"] = "1"
+        t0 = time.perf_counter()
+        try:
+            g.run()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        elapsed = time.perf_counter() - t0
+        st = g.get_stats()
+        ck = st.get("Checkpoints", {})
+        rep = [o for o in st["Operators"]
+               if o["name"] == "scan"][0]["replicas"][0]
+        shutil.rmtree(store, ignore_errors=True)
+        completed = ck.get("Checkpoints_completed", 0)
+        dblobs = ck.get("Checkpoint_delta_blobs", 0)
+        dbytes = ck.get("Checkpoint_delta_bytes", 0)
+        fbytes = ck.get("Checkpoint_full_bytes", 0)
+        full_epochs = max(1, completed - dblobs)
+        ratio = ((dbytes / dblobs) / (fbytes / full_epochs)
+                 if dblobs and fbytes else 0.0)
+        return {
+            "key_space": key_space,
+            "tuples": n + key_space,
+            "tuples_per_sec": round((n + key_space) / elapsed, 1),
+            "checkpoints": completed,
+            "delta_blobs": dblobs,
+            "delta_bytes_per_epoch": round(dbytes / dblobs, 1)
+            if dblobs else 0.0,
+            "full_bytes_per_epoch": round(fbytes / full_epochs, 1),
+            "async_uploads": ck.get("Checkpoint_async_uploads", 0),
+            "cut_pause_last_us": rep.get("Checkpoint_cut_pause_usec",
+                                         0.0),
+            "ckpt_delta_bytes_ratio": round(ratio, 4),
+        }
+
     print("replay: at-least-once run", file=sys.stderr)
     alo, alo_res = run(False)
     print("replay: exactly-once run", file=sys.stderr)
@@ -1323,6 +1430,9 @@ def _replay_mode() -> None:
     print("replay: tiered-state run (Zipf 1.1, 10M key space)",
           file=sys.stderr)
     tiered = run_tiered()
+    print("replay: incremental-checkpoint run (delta + async)",
+          file=sys.stderr)
+    delta = run_delta()
     overhead = (100.0 * (1.0 - eo["tuples_per_sec"]
                          / alo["tuples_per_sec"])
                 if alo["tuples_per_sec"] else 0.0)
@@ -1338,6 +1448,9 @@ def _replay_mode() -> None:
         "tiered": tiered,
         "tiered_keys_per_device_budget":
             tiered.get("keys_per_device_budget", 0.0),
+        "ckpt_delta": delta,
+        "ckpt_delta_bytes_ratio":
+            delta.get("ckpt_delta_bytes_ratio", 0.0),
     }
     os.makedirs("results", exist_ok=True)
     with open(os.path.join("results", "replay.json"), "w") as f:

@@ -19,8 +19,9 @@ checkpoint store, and verifies the exactly-once contract:
 
 The durable-recovery plane adds storage-fault rounds (``--storage``:
 truncate/bit-flip a checkpoint blob, delete a manifest, ENOSPC during
-staging, kill during the fallback-ladder walk — recovery must walk to
-the newest fully-verifying checkpoint with byte-identical exactly-once
+staging, kill during the fallback-ladder walk, kill mid async upload,
+corrupt a delta chain's shared ancestor — recovery must walk to the
+newest fully-verifying checkpoint with byte-identical exactly-once
 output) and ``device_loss`` (8-device mesh loses a chip mid-stream,
 recovers degraded onto 7, re-expands to 8 when the probe sees the
 device return).
@@ -55,7 +56,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 STORAGE_SCENARIOS = ("storage_truncate", "storage_bitflip",
                      "storage_manifest", "storage_enospc",
-                     "storage_ladder_kill")
+                     "storage_ladder_kill", "storage_async_kill",
+                     "storage_delta_chain")
 
 SCENARIOS = ("kill_point", "kill_during_commit", "kill_during_rescale",
              "supervised_kill", "overload_kill", "mesh_kill",
@@ -340,6 +342,280 @@ def _storage_round(rng, report, workdir, scenario, golden, n, nk) -> dict:
         verify_failures=sup.get("Recovery_verify_failures", 0),
         ckpt_verify_failures=ck.get("Checkpoint_verify_failures", 0),
         storage_failures=ck.get("Checkpoint_storage_failures", 0),
+        mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
+
+
+def _async_kill_round(rng, report, workdir, golden, n, nk) -> dict:
+    """``storage_async_kill``: crash while an ASYNC snapshot upload is
+    still in flight. With ``WF_CKPT_ASYNC=1`` the barrier only fences
+    the state cut; blob writes happen on the coordinator's upload
+    thread. Every blob write past the early epochs is slowed so the
+    injected crash reliably lands mid-upload. Checks:
+
+    - supervised recovery restores from the last FULLY COMMITTED epoch
+      (the half-uploaded one must never become visible), byte-identical
+      exactly-once output;
+    - ``Checkpoint_async_uploads`` counted work off the hot path and
+      ``Checkpoint_async_pending`` drained to zero by shutdown;
+    - an offline ``verify()`` sweep over the surviving store is clean —
+      no partially-committed epoch leaked into the committed set.
+    """
+    from windflow_tpu.checkpoint import CheckpointStore
+
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+    ckpt_at = sorted(rng.sample(range(100, int(n * 0.5), 60), 2))
+    late_at = rng.randrange(int(n * 0.65), int(n * 0.8))
+    crash_at = late_at + rng.randrange(5, 25)
+    report.update(ckpt_at=ckpt_at, late_ckpt_at=late_at, crash_at=crash_at)
+
+    class AsyncSource(ChaosSource):
+        # early epochs commit-waited (a known-good restore target must
+        # exist); the LATE epoch is requested and streamed past so the
+        # crash finds its upload still in flight
+        def __call__(self, shipper):
+            st = CheckpointStore(store)
+            while self.pos < self.n:
+                if self.pos == self.crash_at and self.crashes < 1:
+                    self.crashes += 1
+                    raise InjectedCrash(f"killed at tuple {self.pos} "
+                                        f"(mid async upload)")
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": v})
+                self.pos += 1
+                if self.pos in self.ckpt_at:
+                    before = st.latest() or 0
+                    shipper.request_checkpoint()
+                    deadline = time.time() + 10
+                    while (st.latest() or 0) <= before \
+                            and time.time() < deadline:
+                        time.sleep(0.002)
+                elif self.pos == late_at:
+                    shipper.request_checkpoint()
+
+    crash_res = []
+    g = _build(store, AsyncSource(n, nk, ckpt_at, crash_at), txn,
+               crash_res, nk, supervised=True)
+
+    orig_wb = CheckpointStore.write_blob
+
+    def slow_wb(self, ckpt_id, op_name, replica_idx, state):
+        if ckpt_id >= 3:  # the late epoch and everything after
+            time.sleep(0.25)
+        return orig_wb(self, ckpt_id, op_name, replica_idx, state)
+
+    CheckpointStore.write_blob = slow_wb
+    old_async = os.environ.get("WF_CKPT_ASYNC")
+    os.environ["WF_CKPT_ASYNC"] = "1"
+    try:
+        g.run()  # recovers in-process; raising here fails the round
+    finally:
+        CheckpointStore.write_blob = orig_wb
+        if old_async is None:
+            os.environ.pop("WF_CKPT_ASYNC", None)
+        else:
+            os.environ["WF_CKPT_ASYNC"] = old_async
+
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    ck = st.get("Checkpoints", {})
+    problems = _verify(golden, crash_res, [], txn)
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if ck.get("Checkpoint_async_uploads", 0) < 1:
+        problems.append("WF_CKPT_ASYNC=1 but no async upload was counted")
+    if ck.get("Checkpoint_async_pending", 0) != 0:
+        problems.append(f"async uploads not drained at shutdown "
+                        f"(pending {ck.get('Checkpoint_async_pending')})")
+    final = CheckpointStore(store)
+    if (final.latest() or 0) < 2:
+        problems.append("no committed epoch survived the async crash")
+    sweep = final.verify()
+    bad = {cid: r["problems"] for cid, r in sweep.items() if not r["ok"]}
+    if bad:
+        problems.append(f"half-uploaded epoch leaked into the committed "
+                        f"set: {bad}")
+    report.update(
+        ok=not problems, problems=problems, results=len(golden),
+        restarts=sup.get("Supervision_restarts", 0),
+        async_uploads=ck.get("Checkpoint_async_uploads", 0),
+        upload_usec_total=ck.get("Checkpoint_upload_usec_total", 0),
+        committed_epochs=final.latest() or 0,
+        mttr_s=sup.get("Supervision_last_restart_s", 0.0))
+    return report
+
+
+def _delta_chain_round(rng, report, workdir) -> dict:
+    """``storage_delta_chain``: corrupt a delta chain's shared ANCESTOR
+    and make recovery walk past the whole dependent chain. With
+    ``WF_CKPT_DELTA=1`` and ``WF_CKPT_FULL_EVERY=3`` a TPU stateful map
+    commits epochs 1=full, 2=Δ(1), 3=Δ(1), 4=full, 5=Δ(4); the crash
+    bit-flips every blob of epoch 4 — the base that epoch 5 resolves
+    through. Checks:
+
+    - ``verify()`` flags epoch 4 AND epoch 5 (transitive closure: one
+      corrupt ancestor poisons every dependent epoch);
+    - the fallback ladder rejects 5 (corrupt base), rejects 4, and
+      lands on 3 (``Recovery_ladder_depth == 2``), which materializes
+      through the INTACT epoch-1 base — a delta-chain restore under
+      fire;
+    - byte-identical exactly-once output vs an uninterrupted golden.
+    """
+    import numpy as np
+
+    from windflow_tpu import (ExecutionMode, PipeGraph, RestartPolicy,
+                              Sink_Builder, Source_Builder, TimePolicy)
+    from windflow_tpu.checkpoint import CheckpointStore
+    from windflow_tpu.sinks.transactional import read_committed_records
+    from windflow_tpu.tpu import Map_TPU_Builder
+
+    n, nk = 1600, 12
+    ckpt_at = sorted(rng.sample(range(100, int(n * 0.55), 40), 5))
+    crash_at = rng.randrange(int(n * 0.7), n - 50)
+    report.update(n=n, nk=nk, ckpt_at=ckpt_at, crash_at=crash_at)
+    store = os.path.join(workdir, "store")
+    txn = os.path.join(workdir, "txn")
+
+    def build(store_dir, txn_dir, src, rows, supervised):
+        g = PipeGraph("chaos_delta", ExecutionMode.DEFAULT,
+                      TimePolicy.INGRESS_TIME)
+        # retain the whole chain: the corrupted ancestor, its dependents
+        # and the intact base must all still be on disk at the crash
+        g.with_checkpointing(store_dir=store_dir, retain=8)
+        if supervised:
+            g.with_supervision(RestartPolicy(max_restarts=4,
+                                             backoff_s=0.02,
+                                             backoff_max_s=0.2))
+        op = (Map_TPU_Builder(
+                lambda row, st: ({"k": row["k"], "v": st + row["v"]},
+                                 st + row["v"]))
+              .with_state(np.float32(0)).with_key_by("k")
+              .with_name("dscan").build())
+
+        def sink(t):
+            if t is not None:
+                rows.append((int(t["k"]), float(t["v"])))
+
+        g.add_source(Source_Builder(src).with_name("src")
+                     .with_output_batch_size(8).build()) \
+            .add(op) \
+            .add_sink(Sink_Builder(sink).with_name("snk")
+                      .with_exactly_once(staging_dir=txn_dir).build())
+        return g
+
+    def committed(txn_dir):
+        return sorted((int(r["k"]), float(r["v"]))
+                      for r, _ in read_committed_records(
+                          os.path.join(txn_dir, "snk_r0")))
+
+    def corrupt_epoch(_crash_no):
+        # flip one byte of EVERY physically-written blob of epoch 4:
+        # the full base both delta epochs after it resolve through
+        st = CheckpointStore(store)
+        d = st._dirname(4)
+        for fname in sorted(f for f in os.listdir(d)
+                            if f.endswith(".blob")):
+            path = os.path.join(d, fname)
+            with open(path, "r+b") as f:
+                off = rng.randrange(os.path.getsize(path))
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+        sweep = st.verify()
+        report["verify_flagged"] = sorted(
+            cid for cid, r in sweep.items() if not r["ok"])
+
+    class DeltaSource(ChaosSource):
+        # every epoch commit-waited: the 1=F,2=Δ,3=Δ,4=F,5=Δ cadence
+        # needs each base committed before the next capture runs
+        def __call__(self, shipper):
+            st = CheckpointStore(store if self.on_crash else
+                                 os.path.join(workdir, "gold_store"))
+            while self.pos < self.n:
+                if self.pos == self.crash_at and self.crashes < 1:
+                    self.crashes += 1
+                    if self.on_crash is not None:
+                        self.on_crash(self.crashes)
+                    raise InjectedCrash(f"killed at tuple {self.pos}")
+                v = self.pos
+                shipper.push({"k": v % self.nk, "v": float(v + 1)})
+                self.pos += 1
+                if self.pos in self.ckpt_at:
+                    before = st.latest() or 0
+                    shipper.request_checkpoint()
+                    deadline = time.time() + 10
+                    while (st.latest() or 0) <= before \
+                            and time.time() < deadline:
+                        time.sleep(0.002)
+
+    old_env = {k: os.environ.get(k)
+               for k in ("WF_CKPT_DELTA", "WF_CKPT_FULL_EVERY")}
+    os.environ["WF_CKPT_DELTA"] = "1"
+    os.environ["WF_CKPT_FULL_EVERY"] = "3"
+    try:
+        gold_rows = []
+        build(os.path.join(workdir, "gold_store"),
+              os.path.join(workdir, "gold_txn"), DeltaSource(n, nk,
+                                                             ckpt_at),
+              gold_rows, supervised=False).run()
+        golden = committed(os.path.join(workdir, "gold_txn"))
+
+        rows = []
+        g = build(store, txn, DeltaSource(n, nk, ckpt_at, crash_at,
+                                          on_crash=corrupt_epoch),
+                  rows, supervised=True)
+        g.run()  # recovers in-process; raising here fails the round
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    st = g.get_stats()
+    sup = st.get("Supervision", {})
+    ck = st.get("Checkpoints", {})
+    segs = committed(txn)
+    problems = []
+    flagged = report.get("verify_flagged", [])
+    if 4 not in flagged:
+        problems.append(f"verify() missed the corrupted ancestor 4 "
+                        f"(flagged {flagged})")
+    if 5 not in flagged:
+        problems.append(f"verify() missed dependent delta epoch 5 "
+                        f"(flagged {flagged})")
+    if any(cid in flagged for cid in (1, 2, 3)):
+        problems.append(f"verify() over-flagged intact epochs "
+                        f"(flagged {flagged})")
+    if sup.get("Supervision_restarts", 0) != 1:
+        problems.append(f"expected 1 supervised restart, saw "
+                        f"{sup.get('Supervision_restarts')}")
+    if sup.get("Recovery_ladder_depth", 0) != 2:
+        problems.append(f"expected ladder depth 2 (corrupt base kills "
+                        f"5 and 4, land on delta rung 3), saw "
+                        f"{sup.get('Recovery_ladder_depth')}")
+    if sup.get("Recovery_verify_failures", 0) < 2:
+        problems.append("ladder rung failures undercounted for the "
+                        "delta chain")
+    if ck.get("Checkpoint_delta_blobs", 0) < 1:
+        problems.append("WF_CKPT_DELTA=1 but no delta blob was written "
+                        "after recovery")
+    if segs != golden:
+        dup = len(segs) - len(set(segs))
+        lost = len([x for x in golden if x not in set(segs)])
+        problems.append(f"committed records diverge from golden: "
+                        f"{dup} duplicate(s), {lost} lost "
+                        f"(got {len(segs)}, want {len(golden)})")
+    report.update(
+        ok=not problems, problems=problems, results=len(golden),
+        restarts=sup.get("Supervision_restarts", 0),
+        ladder_depth=sup.get("Recovery_ladder_depth", 0),
+        verify_failures=sup.get("Recovery_verify_failures", 0),
+        delta_blobs=ck.get("Checkpoint_delta_blobs", 0),
+        delta_bytes=ck.get("Checkpoint_delta_bytes", 0),
         mttr_s=sup.get("Supervision_last_restart_s", 0.0))
     return report
 
@@ -869,9 +1145,15 @@ def run_round(seed: int, scenario: str, workdir: str, n: int = 2000,
         return _tiered_kill_round(rng, report, workdir)
     if scenario == "device_loss":
         return _device_loss_round(rng, report, workdir)
+    if scenario == "storage_delta_chain":
+        # runs its own (TPU stateful-map) pipeline: CPU windows never
+        # emit state deltas, so the chain must come from a TPU engine
+        return _delta_chain_round(rng, report, workdir)
     golden = _golden(workdir, n, nk)
     store = os.path.join(workdir, "store")
     txn = os.path.join(workdir, "txn")
+    if scenario == "storage_async_kill":
+        return _async_kill_round(rng, report, workdir, golden, n, nk)
     if scenario in STORAGE_SCENARIOS:
         return _storage_round(rng, report, workdir, scenario, golden,
                               n, nk)

@@ -74,6 +74,12 @@ REQUIRED_FAMILIES = (
     "windflow_recovery_verify_failures_total",
     "windflow_recovery_degraded_devices",
     "windflow_ckpt_verify_failures_total",
+    # incremental + async checkpointing (0-valued while WF_CKPT_DELTA /
+    # WF_CKPT_ASYNC are off, but the families must export)
+    "windflow_checkpoint_cut_pause_seconds",
+    "windflow_checkpoint_delta_bytes_total",
+    "windflow_checkpoint_async_uploads_total",
+    "windflow_checkpoint_async_pending",
     # dead-letter / error-policy + Kafka retry accounting (per-replica
     # scalars: present with value 0 on every replica when unused)
     "windflow_dlq_records_total",

@@ -120,6 +120,31 @@ def test_chaos_storage_ladder_kill(tmp_path):
     assert rep["verify_failures"] >= 2
 
 
+def test_chaos_storage_async_kill(tmp_path):
+    """Crash while an async snapshot upload is in flight
+    (``WF_CKPT_ASYNC=1``, blob writes slowed): recovery restores from
+    the last fully-committed epoch, the half-uploaded epoch never
+    becomes visible (offline ``verify()`` sweep is clean), async
+    uploads were counted, and the pending gauge drained to zero."""
+    rep = chaos.run_round(37, "storage_async_kill", str(tmp_path), n=1500)
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] == 1
+    assert rep["async_uploads"] >= 1
+
+
+def test_chaos_storage_delta_chain(tmp_path):
+    """Corrupt a delta chain's shared ancestor (epoch 4 of a
+    1=full, 2=Δ(1), 3=Δ(1), 4=full, 5=Δ(4) chain): ``verify()`` flags
+    epoch 4 AND its dependent 5, the ladder walks past the whole
+    poisoned chain (depth 2) and lands on delta rung 3, which
+    materializes through the intact epoch-1 base byte-identically."""
+    rep = chaos.run_round(41, "storage_delta_chain", str(tmp_path))
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] == 1
+    assert rep["ladder_depth"] == 2
+    assert 4 in rep["verify_flagged"] and 5 in rep["verify_flagged"]
+
+
 @pytest.mark.mesh
 def test_chaos_device_loss(tmp_path):
     """The failover acceptance round: an 8-device mesh loses a chip

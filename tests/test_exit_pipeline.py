@@ -336,12 +336,19 @@ def test_stage_emitter_ships_partial_on_age(monkeypatch):
     assert len(sent) == 2 and sent[1].size == 1
     # amortized path without touching internals: _SWEEP_EVERY appends
     # after the bound expires must ship the stale buffer mid-stream
+    before = len(sent)
     for i in range(5, 5 + em._SWEEP_EVERY // 2):
         em.emit({"v": i}, ts=i, wm=0)
     _t.sleep(0.03)
     for i in range(1000, 1000 + em._SWEEP_EVERY):
         em.emit({"v": i}, ts=i, wm=0)
-    assert len(sent) == 3  # swept by the countdown, not by batch fill
+    # swept by the countdown, not by batch fill: everything shipped is
+    # a PARTIAL batch. A loaded host can stretch the append loops past
+    # the 20 ms bound, legally triggering extra sweeps (and a periodic
+    # punctuation), so the exact ship count is not pinned.
+    batches = [b for b in sent[before:] if hasattr(b, "size")]
+    assert batches, "countdown sweep never shipped the stale buffer"
+    assert all(b.size < em.output_batch_size for b in batches)
 
 
 class _RecPort:

@@ -27,8 +27,9 @@ here: a grid-scan table or FFAT forest is a handful of ``device_get``
 calls per replica, not a per-operator serializer.
 """
 
+from . import delta
 from .coordinator import CheckpointCoordinator
 from .store import CheckpointStore, CorruptCheckpointError
 
 __all__ = ["CheckpointCoordinator", "CheckpointStore",
-           "CorruptCheckpointError"]
+           "CorruptCheckpointError", "delta"]

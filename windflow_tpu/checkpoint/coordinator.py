@@ -19,11 +19,13 @@ sees fully-acked checkpoints, which is the correctness contract.
 from __future__ import annotations
 
 import os
+import queue
 import shutil
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
+from .delta import env_ckpt_async
 from .store import CheckpointStore
 
 
@@ -116,6 +118,21 @@ class CheckpointCoordinator:
         self._hold_directive = "resume"
         self.parked: Set[str] = set()
         self._commit_acked: Dict[int, Set[str]] = {}  # cid -> acked names
+        # async snapshot upload (WF_CKPT_ASYNC): an ack only registers
+        # the captured blobs as a PENDING upload handle and returns —
+        # the worker's cut pause ends there. A single background
+        # uploader serializes + writes off the hot path; the epoch
+        # finalizes only when every worker acked AND every upload
+        # landed (ent["uploads"] == 0). A crash/OSError mid-upload
+        # fails the epoch loudly through the same storage-failure path
+        # as a synchronous write — exactly-once epoch-id semantics and
+        # the fallback ladder are unchanged.
+        self.async_enabled = env_ckpt_async()
+        self._upload_q: Optional[queue.Queue] = None
+        self._upload_thread: Optional[threading.Thread] = None
+        self.async_uploads = 0       # uploads completed (any outcome)
+        self.async_pending = 0       # uploads currently in flight
+        self.upload_usec_total = 0.0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -133,6 +150,12 @@ class CheckpointCoordinator:
         if t is not None:
             t.join(timeout=3)
             self._thread = None
+        q = self._upload_q
+        if q is not None and self._upload_thread is not None:
+            q.put(None)  # sentinel: drain remaining uploads, then exit
+            self._upload_thread.join(timeout=5)
+            self._upload_thread = None
+            self._upload_q = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -187,7 +210,11 @@ class CheckpointCoordinator:
         """One worker's snapshot for one checkpoint: ``blobs`` maps
         ``(op_name, replica_idx)`` to the replica's state dict. Returns
         bytes written (0 when the checkpoint is unknown/already
-        committed — a late barrier after a commit-by-timeout)."""
+        committed — a late barrier after a commit-by-timeout; also 0 in
+        async mode, where the write happens off this thread and the
+        bytes land in the epoch's tally when the upload does)."""
+        if self.async_enabled:
+            return self._ack_async(ckpt_id, worker_name, blobs)
         nbytes = 0
         with self._store_lock:
             with self._lock:
@@ -215,10 +242,106 @@ class CheckpointCoordinator:
             ent["acked"].add(worker_name)
             ent["bytes"] += nbytes
             done = (self.expected_acks > 0
-                    and len(ent["acked"]) >= self.expected_acks)
+                    and len(ent["acked"]) >= self.expected_acks
+                    and ent.get("uploads", 0) == 0)
         if done:
             self._finalize(ckpt_id)
         return nbytes
+
+    # -- async snapshot upload (WF_CKPT_ASYNC) -----------------------------
+    def _ack_async(self, ckpt_id: int, worker_name: str,
+                   blobs: Dict[Any, Any]) -> int:
+        """Register the captured blobs as a pending upload handle and
+        return immediately: the barrier fenced only the state CUT. The
+        epoch cannot finalize until this upload lands."""
+        from ..monitoring.flightrec import thread_recorder
+
+        with self._lock:
+            ent = self._pending.get(ckpt_id)
+            if ent is None:
+                return 0
+            ent["acked"].add(worker_name)
+            ent["uploads"] = ent.get("uploads", 0) + 1
+            self.async_pending += 1
+        self._ensure_uploader()
+        # the entry object rides along as an incarnation token: after a
+        # crash + in-process restart the same ckpt_id can be re-begun
+        # with a FRESH entry, and a stale pre-crash upload must not
+        # write into (or fail) the reincarnated epoch
+        self._upload_q.put((ckpt_id, worker_name, blobs,
+                            thread_recorder(), ent))
+        return 0
+
+    def _ensure_uploader(self) -> None:
+        with self._lock:
+            if self._upload_thread is not None:
+                return
+            self._upload_q = queue.Queue()
+            self._upload_thread = threading.Thread(
+                target=self._upload_loop,
+                name=f"{self.graph_name}/ckpt-upload", daemon=True)
+        self._upload_thread.start()
+
+    def _upload_loop(self) -> None:
+        while True:
+            item = self._upload_q.get()
+            if item is None:
+                return
+            self._upload_one(*item)
+
+    def _upload_one(self, ckpt_id: int, worker_name: str,
+                    blobs: Dict[Any, Any], rec: Any, ent: dict) -> None:
+        from ..monitoring.flightrec import rec_evt_safe
+
+        t0 = time.perf_counter()
+        nbytes = 0
+        failed = None
+        try:
+            with self._store_lock:
+                with self._lock:
+                    # identity, not id: a reincarnated epoch (crash +
+                    # restart re-begins the same ckpt_id) has a fresh
+                    # entry and this upload is abandoned
+                    alive = self._pending.get(ckpt_id) is ent
+                if alive:
+                    for (op_name, idx), state in blobs.items():
+                        nbytes += self.store.write_blob(
+                            ckpt_id, op_name, idx, state)
+        except OSError as e:
+            # same loud-epoch-failure contract as a synchronous write:
+            # the epoch dies, the worker (long resumed) never notices
+            failed = e
+            shutil.rmtree(self.store._dirname(ckpt_id, staging=True),
+                          ignore_errors=True)
+        dur_us = (time.perf_counter() - t0) * 1e6
+        done = False
+        with self._lock:
+            self.async_pending -= 1
+            self.async_uploads += 1
+            self.upload_usec_total += dur_us
+            stale = self._pending.get(ckpt_id) is not ent
+            if failed is not None:
+                if not stale:
+                    self._fail_epoch_storage_locked(ckpt_id, worker_name,
+                                                    failed)
+            elif not stale:
+                ent["uploads"] -= 1
+                ent["bytes"] += nbytes
+                done = (self.expected_acks > 0
+                        and len(ent["acked"]) >= self.expected_acks
+                        and ent["uploads"] == 0)
+        if failed is not None:
+            if not stale:
+                self._notify_aborted(ckpt_id)
+            return
+        if rec is not None:
+            # the acking worker's ring, written cross-thread: one racy
+            # slot write, tolerated the same way the stall watchdog's is
+            rec_evt_safe(rec, "ckpt:upload", dur_us,
+                         {"ckpt_id": ckpt_id, "worker": worker_name,
+                          "bytes": nbytes})
+        if done:
+            self._finalize(ckpt_id)
 
     def retire(self, worker_name: str, blobs: Dict[Any, Any]) -> None:
         """A worker finished cleanly: remember its final blobs and ack
@@ -463,4 +586,12 @@ class CheckpointCoordinator:
                 "Checkpoint_storage_failures": self.storage_failures,
                 "Checkpoint_verify_failures": self.store.verify_failures,
                 "Checkpoint_last_failure": self.last_failure,
+                # incremental/async plane (WF_CKPT_DELTA / WF_CKPT_ASYNC)
+                "Checkpoint_delta_blobs": self.store.delta_blobs,
+                "Checkpoint_delta_bytes": self.store.delta_bytes,
+                "Checkpoint_full_bytes": self.store.full_bytes,
+                "Checkpoint_async_pending": self.async_pending,
+                "Checkpoint_async_uploads": self.async_uploads,
+                "Checkpoint_upload_usec_total": round(
+                    self.upload_usec_total, 1),
             }

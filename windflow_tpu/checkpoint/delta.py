@@ -1,0 +1,280 @@
+"""Incremental-checkpoint plane: delta markers, snapshot context, apply.
+
+Three cooperating pieces make checkpoint cost scale with CHANGE RATE
+instead of state size (opt-in via ``WF_CKPT_DELTA``):
+
+1. **Content-addressed blob refs** (``store.py``): a blob whose payload
+   digest matches the previous committed epoch's is *referenced* in the
+   manifest (``refs``), never rewritten. Pure storage-side dedup — it
+   needs nothing from this module beyond the env knobs.
+2. **State deltas** (this module): an engine that tracks its touched
+   slot rows emits a *delta node* instead of the full state dict — the
+   dirty rows plus small replaced fields, plus the epoch id of the FULL
+   snapshot they patch (``base``). The store records the dependency in
+   the manifest (``deps``) and ``load_states`` materializes the full
+   state transparently, so the supervisor ladder, the repartitioner and
+   ``restore_from=`` never see a delta.
+3. **Snapshot context**: ``Worker._capture_blobs`` wraps the capture in
+   ``capturing(ckpt_id, store)``; engines consult ``snapshot_ctx()`` /
+   ``delta_eligible`` to decide full vs delta. No context (retirement
+   snapshots, direct ``snapshot_state`` calls) always means FULL — the
+   conservative default keeps every non-checkpoint path byte-identical
+   to the pre-delta behavior.
+
+Chain-length discipline: an engine's delta base is always its LAST FULL
+snapshot (never a previous delta), so a delta chain is one hop deep at
+the state level and ``WF_CKPT_FULL_EVERY`` (default 8) bounds how long
+a base must be retained. A base epoch that failed to commit simply
+fails ``delta_eligible`` at the next capture and the engine re-emits a
+full snapshot — self-healing, no coordination.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Set
+
+import numpy as np
+
+DELTA_KEY = "__state_delta__"
+
+
+# -- env knobs ---------------------------------------------------------------
+def env_ckpt_delta() -> bool:
+    """``WF_CKPT_DELTA``: opt-in incremental checkpointing (blob refs +
+    state deltas). Off by default — the on-disk layout stays exactly the
+    pre-delta format until the operator asks for deltas."""
+    v = os.environ.get("WF_CKPT_DELTA", "0").strip().lower()
+    return v not in ("0", "false", "off", "no", "")
+
+
+def env_ckpt_async() -> bool:
+    """``WF_CKPT_ASYNC``: opt-in asynchronous snapshot upload — the
+    barrier fences only the state CUT (device/host copy); serialization
+    and the blob writes run on a background uploader and the epoch
+    commits when every upload lands. Off by default."""
+    v = os.environ.get("WF_CKPT_ASYNC", "0").strip().lower()
+    return v not in ("0", "false", "off", "no", "")
+
+
+def env_ckpt_full_every() -> int:
+    """``WF_CKPT_FULL_EVERY``: emit a FULL state snapshot at least every
+    N captures (bounds delta-chain length and how far back a base epoch
+    must be retained). Default 8, minimum 1 (1 = always full)."""
+    try:
+        return max(1, int(os.environ.get("WF_CKPT_FULL_EVERY", "8")))
+    except ValueError:
+        return 8
+
+
+# -- snapshot context --------------------------------------------------------
+class SnapshotContext:
+    """What the engines need to know about the capture in progress: the
+    epoch id being snapshotted and whether a candidate base epoch is
+    committed on disk (cached — one directory listing per capture)."""
+
+    __slots__ = ("ckpt_id", "store", "_committed")
+
+    def __init__(self, ckpt_id: int, store) -> None:
+        self.ckpt_id = int(ckpt_id)
+        self.store = store
+        self._committed: Optional[Set[int]] = None
+
+    def is_committed(self, cid: int) -> bool:
+        if self._committed is None:
+            try:
+                self._committed = set(self.store.completed_ids())
+            except Exception:
+                self._committed = set()
+        return cid in self._committed
+
+
+_tls = threading.local()
+
+
+@contextmanager
+def capturing(ckpt_id: Optional[int], store) -> Any:
+    """Install the snapshot context for the duration of one blob
+    capture (``Worker._capture_blobs``). Nested/absent-safe."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = (SnapshotContext(ckpt_id, store)
+                if ckpt_id is not None and store is not None else None)
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = prev
+
+
+def snapshot_ctx() -> Optional[SnapshotContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def delta_eligible(base_ckpt: Optional[int], snaps_since_full: int,
+                   ctx: Optional[SnapshotContext] = None) -> bool:
+    """May the current capture emit a delta against ``base_ckpt``?
+    Requires: a capture context, the knob on, a known base, the
+    full-snapshot cadence not yet due, and the base COMMITTED on disk
+    (an uncommitted base means the full snapshot it rode never became
+    restorable — re-emit full)."""
+    if ctx is None:
+        ctx = snapshot_ctx()
+    if ctx is None or base_ckpt is None or not env_ckpt_delta():
+        return False
+    if snaps_since_full + 1 >= env_ckpt_full_every():
+        return False
+    return ctx.is_committed(int(base_ckpt))
+
+
+# -- delta nodes -------------------------------------------------------------
+def make_delta(base_ckpt: int, rows: Optional[Dict[str, Any]] = None,
+               shards: Optional[Dict[str, Any]] = None,
+               replace: Optional[Dict[str, Any]] = None,
+               carry: Optional[List[str]] = None) -> dict:
+    """Build a delta node patching the same-path subtree of the base
+    epoch's blob:
+
+    - ``rows``: ``{state_key: {"slots": int_array, "leaves": [row_arrays]}}``
+      — slot-row patches along each leaf's leading axis, leaves in
+      ``tree_flatten`` order of the base value;
+    - ``shards``: ``{state_key: [per-shard rows-patch or None]}`` — the
+      mesh twin's block-sharded tables (base value is a LIST of shard
+      pytrees, patched per shard);
+    - ``replace``: small fields stored whole (may themselves contain
+      nested delta nodes, e.g. a tier WAL delta);
+    - ``carry``: field names copied VERBATIM from the base subtree —
+      zero bytes in the delta. The key directory rides here when no key
+      registered since the base, so delta size cannot regrow with the
+      number of keys.
+    """
+    node: Dict[str, Any] = {DELTA_KEY: 1, "base": int(base_ckpt)}
+    if rows:
+        node["rows"] = rows
+    if shards:
+        node["shards"] = shards
+    if replace:
+        node["replace"] = replace
+    if carry:
+        node["carry"] = list(carry)
+    return node
+
+
+def make_tier_delta(base_ckpt: int, wal_puts: List, wal_dels: List,
+                    replace: Dict[str, Any]) -> dict:
+    """A tiered-store sub-blob delta: the cold tier as a WAL (puts/dels
+    since the base's full cold image) plus the replaced bookkeeping
+    fields. Applied by ``state.tiered.apply_tier_delta``."""
+    return {DELTA_KEY: 1, "base": int(base_ckpt), "kind": "tier",
+            "wal_puts": list(wal_puts), "wal_dels": list(wal_dels),
+            "replace": dict(replace)}
+
+
+def is_delta(node: Any) -> bool:
+    return isinstance(node, dict) and DELTA_KEY in node
+
+
+def delta_bases(state: Any, _out: Optional[Set[int]] = None) -> Set[int]:
+    """Every base epoch id referenced by delta nodes anywhere in a
+    state tree (structure walk only — array leaves are not entered)."""
+    out: Set[int] = set() if _out is None else _out
+    if isinstance(state, dict):
+        if DELTA_KEY in state:
+            out.add(int(state["base"]))
+        for v in state.values():
+            delta_bases(v, out)
+    elif isinstance(state, (list, tuple)):
+        for v in state:
+            delta_bases(v, out)
+    return out
+
+
+# -- application -------------------------------------------------------------
+def _apply_rows(base_val: Any, patch: Dict[str, Any]) -> Any:
+    """Patch dirty slot rows into a copy of ``base_val`` (any pytree of
+    arrays sharing a leading slot axis)."""
+    import jax
+
+    slots = np.asarray(patch["slots"])
+    leaves, treedef = jax.tree_util.tree_flatten(base_val)
+    rows = patch["leaves"]
+    if len(rows) != len(leaves):
+        raise ValueError(
+            f"state-delta row patch holds {len(rows)} leaves, base value "
+            f"has {len(leaves)} — base/delta structure mismatch")
+    out = []
+    for b, r in zip(leaves, rows):
+        arr = np.array(np.asarray(b), copy=True)
+        if len(slots):
+            arr[slots] = r
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _descend(bases: Dict[int, Any], key: Any) -> Dict[int, Any]:
+    out = {}
+    for cid, bs in bases.items():
+        if isinstance(bs, dict):
+            out[cid] = bs.get(key)
+        elif isinstance(bs, (list, tuple)) and isinstance(key, int) \
+                and 0 <= key < len(bs):
+            out[cid] = bs[key]
+        else:
+            out[cid] = None
+    return out
+
+
+def _apply_node(node: dict, bases: Dict[int, Any]) -> Any:
+    base = bases.get(int(node["base"]))
+    if node.get("kind") == "tier":
+        from ..state.tiered import apply_tier_delta
+        if base is None:
+            raise ValueError(
+                "tier WAL delta has no base tier sub-blob to patch")
+        return apply_tier_delta(base, node)
+    if base is None:
+        raise ValueError(
+            "state delta has no corresponding base subtree to patch "
+            f"(base epoch {node['base']})")
+    out: Dict[str, Any] = {}
+    for k in node.get("carry") or ():
+        out[k] = base[k]
+    for k, v in (node.get("replace") or {}).items():
+        out[k] = resolve(v, _descend({int(node["base"]): base}, k))
+    for k, patch in (node.get("rows") or {}).items():
+        out[k] = _apply_rows(base[k], patch)
+    for k, shard_patches in (node.get("shards") or {}).items():
+        base_shards = base[k]
+        patched = []
+        for i, p in enumerate(shard_patches):
+            if p is None:
+                patched.append(base_shards[i])
+            else:
+                patched.append(_apply_rows(base_shards[i], p))
+        out[k] = patched
+    return out
+
+
+def resolve(state: Any, bases: Dict[int, Any]) -> Any:
+    """Materialize a (possibly delta-bearing) state tree against the
+    base states, recursively: delta nodes apply against the same-path
+    subtree of their base epoch's blob, plain containers recurse, array
+    leaves pass through untouched."""
+    if isinstance(state, dict):
+        if DELTA_KEY in state:
+            return _apply_node(state, bases)
+        return {k: resolve(v, _descend(bases, k))
+                for k, v in state.items()}
+    if isinstance(state, list):
+        return [resolve(v, _descend(bases, i))
+                for i, v in enumerate(state)]
+    return state
+
+
+def materialize(state: Any, base_states: Dict[int, Any]) -> Any:
+    """Entry point for the store: reconstruct the FULL state of one blob
+    from its delta-bearing form plus the (already materialized) states
+    of every base epoch it references, keyed by epoch id."""
+    if not delta_bases(state):
+        return state
+    return resolve(state, dict(base_states))

@@ -31,6 +31,28 @@ bit-flipped blob raises a typed ``CorruptCheckpointError`` naming the
 bad file instead of feeding garbage state into the graph. Manifests
 written before this scheme carry no ``digests`` map and restore with a
 warning, never an error.
+
+Incremental checkpoints (``WF_CKPT_DELTA``, off by default) add two
+manifest maps — the on-disk layout stays readable by pre-delta restores
+of non-delta epochs, and pre-delta manifests keep restoring unchanged:
+
+- ``refs: {fname: ancestor_ckpt_id}`` — this epoch's blob is
+  byte-identical to the named committed ancestor's (same payload
+  digest), so the file is *referenced*, not rewritten. Refs always
+  point at the directory PHYSICALLY holding the bytes (one hop, never
+  ref-of-ref): ``write_blob`` resolves through the previous manifest's
+  own refs before recording.
+- ``deps: {fname: [base_ckpt_ids]}`` — this epoch's blob is a *state
+  delta* (dirty slot rows / a cold-tier WAL) patching the named base
+  epochs' same-name blob. ``load_states`` loads the base state(s) and
+  materializes the full state before returning, so every restore
+  consumer (supervisor ladder, repartitioner, ``restore_from=``) still
+  sees full states.
+
+``verify()`` hashes the transitive closure (refs ∪ deps), so a corrupt
+ancestor flags every dependent epoch; ``prune`` keeps the closure of
+the retained epochs alive — a blob is never deleted while any newer
+manifest still references or depends on its directory.
 """
 
 from __future__ import annotations
@@ -47,6 +69,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..basic import WindFlowError
+from . import delta as _delta
 
 MANIFEST = "manifest.json"
 FORMAT_VERSION = 1
@@ -128,6 +151,20 @@ class CheckpointStore:
         # instance (surfaced as Checkpoint_verify_failures /
         # windflow_ckpt_verify_failures_total)
         self.verify_failures = 0
+        # incremental-checkpoint staging state (WF_CKPT_DELTA): per-epoch
+        # blob refs (fname -> ancestor cid physically holding identical
+        # bytes) and state-delta deps (fname -> base cids the state
+        # patches), folded into the manifest at commit
+        self._refs: Dict[int, Dict[str, int]] = {}
+        self._deps: Dict[int, Dict[str, List[int]]] = {}
+        self._ref_base: Dict[int, Optional[int]] = {}
+        self._manifest_cache: Dict[int, Dict[str, Any]] = {}
+        # cumulative incremental-checkpoint counters (this instance):
+        # blobs not written in full form (ref'd or delta-form), the
+        # physical bytes those cost, and the physical bytes of full blobs
+        self.delta_blobs = 0
+        self.delta_bytes = 0
+        self.full_bytes = 0
 
     # -- paths -------------------------------------------------------------
     def _dirname(self, ckpt_id: int, staging: bool = False) -> str:
@@ -142,22 +179,77 @@ class CheckpointStore:
         os.makedirs(staging, exist_ok=True)
         with self._digest_lock:
             self._digests.pop(ckpt_id, None)
+            self._refs.pop(ckpt_id, None)
+            self._deps.pop(ckpt_id, None)
+            # the dedup base for this epoch's blobs: the latest epoch
+            # COMMITTED when staging opened (one listdir per epoch)
+            self._ref_base[ckpt_id] = (
+                self.latest() if _delta.env_ckpt_delta() else None)
+
+    def _committed_manifest(self, cid: int) -> Optional[Dict[str, Any]]:
+        """Manifest of a committed epoch, cached (committed manifests are
+        immutable; pruned entries are evicted by ``prune``)."""
+        with self._digest_lock:
+            m = self._manifest_cache.get(cid)
+        if m is not None:
+            return m
+        try:
+            m = self.load_manifest(self._dirname(cid))
+        except (FileNotFoundError, CorruptCheckpointError):
+            return None
+        with self._digest_lock:
+            self._manifest_cache[cid] = m
+        return m
 
     # -- writes ------------------------------------------------------------
     def write_blob(self, ckpt_id: int, op_name: str, replica_idx: int,
                    state: Any) -> int:
         """Pickle one replica's snapshot into the staging dir (atomic
-        tmp+rename). Returns the byte size written."""
+        tmp+rename). Returns the logical byte size of the snapshot.
+
+        With ``WF_CKPT_DELTA`` on (and digests available), a payload
+        whose digest matches the previous committed epoch's same-name
+        blob is recorded as a manifest *ref* instead of rewritten —
+        zero physical bytes for an unchanged shard."""
         staging = self._dirname(ckpt_id, staging=True)
         os.makedirs(staging, exist_ok=True)
         payload = pickle.dumps(
             {"op": op_name, "replica": replica_idx, "state": state},
             protocol=pickle.HIGHEST_PROTOCOL)
         fname = blob_name(op_name, replica_idx)
+        digest = None
         if env_ckpt_verify():
             digest = _hash_bytes(payload)
             with self._digest_lock:
                 self._digests.setdefault(ckpt_id, {})[fname] = digest
+        bases = _delta.delta_bases(state)
+        with self._digest_lock:
+            if bases:
+                self._deps.setdefault(ckpt_id, {})[fname] = sorted(
+                    int(b) for b in bases)
+            else:
+                self._deps.get(ckpt_id, {}).pop(fname, None)
+        if digest is not None and _delta.env_ckpt_delta():
+            base_cid = self._ref_base.get(ckpt_id)
+            if base_cid is not None:
+                bman = self._committed_manifest(base_cid)
+                if bman is not None and \
+                        (bman.get("digests") or {}).get(fname) == digest:
+                    # identical bytes already on disk: resolve through
+                    # the base's own refs so our ref points one hop at
+                    # the directory physically holding the blob
+                    phys = int((bman.get("refs") or {}).get(fname, base_cid))
+                    with self._digest_lock:
+                        self._refs.setdefault(ckpt_id, {})[fname] = phys
+                        self.delta_blobs += 1
+                    return len(payload)
+        with self._digest_lock:
+            self._refs.get(ckpt_id, {}).pop(fname, None)
+            if bases:
+                self.delta_blobs += 1
+                self.delta_bytes += len(payload)
+            else:
+                self.full_bytes += len(payload)
         _atomic_write(os.path.join(staging, fname), payload)
         return len(payload)
 
@@ -177,12 +269,25 @@ class CheckpointStore:
         manifest = dict(manifest)
         manifest.setdefault("format", FORMAT_VERSION)
         manifest["ckpt_id"] = ckpt_id
-        manifest["blobs"] = self.staged_blobs(ckpt_id)
         with self._digest_lock:
             cached = self._digests.pop(ckpt_id, {})
+            refs = dict(self._refs.pop(ckpt_id, {}))
+            deps = dict(self._deps.pop(ckpt_id, {}))
+            self._ref_base.pop(ckpt_id, None)
+        staged = self.staged_blobs(ckpt_id)
+        # a blob both staged and ref'd (re-written within one epoch)
+        # carries identical bytes either way — prefer the local file
+        refs = {f: c for f, c in refs.items() if f not in staged}
+        manifest["blobs"] = sorted(set(staged) | set(refs))
+        if refs:
+            manifest["refs"] = {f: int(c) for f, c in sorted(refs.items())}
+        if deps:
+            manifest["deps"] = {f: [int(x) for x in b]
+                                for f, b in sorted(deps.items())}
         if env_ckpt_verify():
             # blobs written through another store instance (or with the
             # knob off at write time) aren't in the cache: hash the file
+            # (ref'd blobs are always cached — a ref requires the digest)
             manifest["digests"] = {
                 fname: cached.get(fname)
                 or _hash_file(os.path.join(staging, fname))
@@ -191,6 +296,8 @@ class CheckpointStore:
                       json.dumps(manifest, indent=1).encode())
         shutil.rmtree(final, ignore_errors=True)  # same-id re-commit
         os.replace(staging, final)
+        with self._digest_lock:
+            self._manifest_cache[ckpt_id] = manifest
         self.prune()
         return final
 
@@ -201,8 +308,28 @@ class CheckpointStore:
         # delete a checkpoint out from under it mid-read
         with self._lock_of(self.root):
             done = self.completed_ids()
-            for cid in done[:-self.retain]:
-                shutil.rmtree(self._dirname(cid), ignore_errors=True)
+            # retention keeps the last `retain` epochs PLUS the closure
+            # of every epoch they reference or depend on: a delta chain's
+            # ancestor blob is never dropped while a retained manifest
+            # still resolves into it (the ref-count fix for delta chains)
+            keep = set(done[-self.retain:])
+            frontier = list(keep)
+            while frontier:
+                m = self._committed_manifest(frontier.pop())
+                if m is None:
+                    continue
+                targets = {int(c) for c in (m.get("refs") or {}).values()}
+                for bases in (m.get("deps") or {}).values():
+                    targets.update(int(b) for b in bases)
+                for t in targets:
+                    if t not in keep:
+                        keep.add(t)
+                        frontier.append(t)
+            for cid in done:
+                if cid not in keep:
+                    shutil.rmtree(self._dirname(cid), ignore_errors=True)
+                    with self._digest_lock:
+                        self._manifest_cache.pop(cid, None)
             # staging debris older than the newest committed checkpoint
             # can never complete (its coordinator is gone) — clean it up
             if done:
@@ -292,7 +419,15 @@ class CheckpointStore:
         against the manifest's digest BEFORE unpickling; any mismatch,
         missing blob, or undecodable pickle raises
         ``CorruptCheckpointError`` naming the bad file. Pre-digest
-        manifests (no ``digests`` map) restore with a warning."""
+        manifests (no ``digests`` map) restore with a warning.
+
+        Incremental epochs restore transparently: ref'd blobs are read
+        from the ancestor directory physically holding them, and
+        delta-form states are materialized against their base epoch's
+        blob — the caller always receives FULL states. A missing or
+        corrupt ancestor anywhere in the chain raises
+        ``CorruptCheckpointError`` (the ladder then walks past every
+        epoch depending on it)."""
         verify = env_ckpt_verify()
         digests = manifest.get("digests") or {}
         blobs = manifest.get("blobs", [])
@@ -302,39 +437,87 @@ class CheckpointStore:
                 "(written before integrity verification, or with "
                 "WF_CKPT_VERIFY=0): restoring unverified",
                 RuntimeWarning, stacklevel=2)
+        root = os.path.dirname(os.path.abspath(ckpt_dir)) or self.root
         out: Dict[Tuple[str, int], Any] = {}
-        with self._lock_of(os.path.dirname(os.path.abspath(ckpt_dir))
-                           or self.root):
+        with self._lock_of(root):
             for fname in blobs:
-                path = os.path.join(ckpt_dir, fname)
-                want = digests.get(fname) if verify else None
-                if want is not None:
-                    try:
-                        got = _hash_file(path)
-                    except OSError as e:
-                        self.verify_failures += 1
-                        raise CorruptCheckpointError(
-                            f"checkpoint blob {path}: unreadable "
-                            f"({type(e).__name__}: {e})") from e
-                    if got != want:
-                        self.verify_failures += 1
-                        raise CorruptCheckpointError(
-                            f"checkpoint blob {path}: content digest "
-                            f"mismatch (manifest {want}, file {got}) — "
-                            "the blob is torn or corrupted on disk")
+                state, op, rep = self._load_state_chain(
+                    root, ckpt_dir, manifest, fname, verify)
+                out[(op, rep)] = state
+        return out
+
+    def _read_blob_checked(self, blob_dir: str, fname: str,
+                           want: Optional[str]) -> Dict[str, Any]:
+        path = os.path.join(blob_dir, fname)
+        if want is not None:
+            try:
+                got = _hash_file(path)
+            except OSError as e:
+                self.verify_failures += 1
+                raise CorruptCheckpointError(
+                    f"checkpoint blob {path}: unreadable "
+                    f"({type(e).__name__}: {e})") from e
+            if got != want:
+                self.verify_failures += 1
+                raise CorruptCheckpointError(
+                    f"checkpoint blob {path}: content digest "
+                    f"mismatch (manifest {want}, file {got}) — "
+                    "the blob is torn or corrupted on disk")
+        try:
+            return self.load_blob(blob_dir, fname)
+        except CorruptCheckpointError:
+            raise
+        except Exception as e:
+            # digest matched (or verification off) yet the pickle
+            # is undecodable / the file vanished: still corruption
+            self.verify_failures += 1
+            raise CorruptCheckpointError(
+                f"checkpoint blob {path}: undecodable "
+                f"({type(e).__name__}: {e})") from e
+
+    def _load_state_chain(self, root: str, ckpt_dir: str,
+                          manifest: Dict[str, Any], fname: str,
+                          verify: bool) -> Tuple[Any, str, int]:
+        """One blob's FULL state: read from its physical location (own
+        dir or the ref'd ancestor's), then materialize delta form
+        against the base epoch's same-name blob (recursive — engine
+        chains are one hop deep, base is always a full snapshot)."""
+        digests = manifest.get("digests") or {}
+        refs = manifest.get("refs") or {}
+        blob_dir = ckpt_dir
+        if fname in refs:
+            blob_dir = os.path.join(root, f"ckpt_{int(refs[fname]):010d}")
+        blob = self._read_blob_checked(
+            blob_dir, fname, digests.get(fname) if verify else None)
+        state = blob["state"]
+        bases = _delta.delta_bases(state)
+        if bases:
+            base_states: Dict[int, Any] = {}
+            for bcid in sorted(bases):
+                bdir = os.path.join(root, f"ckpt_{int(bcid):010d}")
                 try:
-                    blob = self.load_blob(ckpt_dir, fname)
-                except CorruptCheckpointError:
-                    raise
-                except Exception as e:
-                    # digest matched (or verification off) yet the pickle
-                    # is undecodable / the file vanished: still corruption
+                    bman = self.load_manifest(bdir)
+                except FileNotFoundError as e:
                     self.verify_failures += 1
                     raise CorruptCheckpointError(
-                        f"checkpoint blob {path}: undecodable "
-                        f"({type(e).__name__}: {e})") from e
-                out[(blob["op"], int(blob["replica"]))] = blob["state"]
-        return out
+                        f"checkpoint blob {os.path.join(ckpt_dir, fname)}: "
+                        f"state delta references epoch {bcid}, whose "
+                        "manifest is missing (ancestor pruned or lost) — "
+                        "the delta chain cannot be materialized") from e
+                bstate, _, _ = self._load_state_chain(
+                    root, bdir, bman, fname, verify)
+                base_states[bcid] = bstate
+            try:
+                state = _delta.materialize(state, base_states)
+            except CorruptCheckpointError:
+                raise
+            except Exception as e:
+                self.verify_failures += 1
+                raise CorruptCheckpointError(
+                    f"checkpoint blob {os.path.join(ckpt_dir, fname)}: "
+                    f"delta materialization failed "
+                    f"({type(e).__name__}: {e})") from e
+        return state, blob["op"], int(blob["replica"])
 
     # -- integrity ---------------------------------------------------------
     def verify(self, ckpt_id: Optional[int] = None) -> Dict[int, Dict[str, Any]]:
@@ -342,41 +525,85 @@ class CheckpointStore:
         committed checkpoint against its manifest, WITHOUT unpickling
         anything. Returns ``{ckpt_id: {"ok", "problems", "blobs",
         "bytes", "digested"}}`` — never raises on corruption, so an
-        operator can survey a damaged store in one call."""
+        operator can survey a damaged store in one call.
+
+        Incremental epochs are checked over their TRANSITIVE closure:
+        a ref'd blob is hashed at its physical ancestor location, and a
+        delta blob's base epoch is verified for the same blob name — a
+        single corrupt ancestor therefore flags every epoch whose chain
+        passes through it."""
         ids = [ckpt_id] if ckpt_id is not None else self.completed_ids()
         report: Dict[int, Dict[str, Any]] = {}
+        memo: Dict[Tuple[int, str], List[str]] = {}
+        manifests: Dict[int, Any] = {}
         with self._lock_of(self.root):
             for cid in ids:
-                d = self._dirname(cid)
                 problems: List[str] = []
                 nbytes = 0
-                digested = False
-                try:
-                    manifest = self.load_manifest(d)
-                except (FileNotFoundError, CorruptCheckpointError) as e:
-                    report[cid] = {"ok": False, "problems": [str(e)],
+                manifest = self._verify_manifest_of(cid, manifests)
+                if isinstance(manifest, str):  # load error message
+                    report[cid] = {"ok": False, "problems": [manifest],
                                    "blobs": 0, "bytes": 0,
                                    "digested": False}
                     continue
-                digests = manifest.get("digests") or {}
-                digested = bool(digests)
+                digested = bool(manifest.get("digests"))
                 for fname in manifest.get("blobs", []):
-                    path = os.path.join(d, fname)
-                    try:
-                        nbytes += os.path.getsize(path)
-                        got = _hash_file(path)
-                    except OSError as e:
-                        problems.append(f"{fname}: unreadable "
-                                        f"({type(e).__name__}: {e})")
-                        continue
-                    want = digests.get(fname)
-                    if want is not None and got != want:
-                        problems.append(f"{fname}: digest mismatch "
-                                        f"(manifest {want}, file {got})")
+                    probs, size = self._verify_blob_closure(
+                        cid, fname, memo, manifests)
+                    problems.extend(probs)
+                    nbytes += size
                 report[cid] = {"ok": not problems, "problems": problems,
                                "blobs": len(manifest.get("blobs", [])),
                                "bytes": nbytes, "digested": digested}
         return report
+
+    def _verify_manifest_of(self, cid: int, manifests: Dict[int, Any]):
+        """Manifest or an error STRING (memoized per verify sweep)."""
+        if cid not in manifests:
+            try:
+                manifests[cid] = self.load_manifest(self._dirname(cid))
+            except (FileNotFoundError, CorruptCheckpointError) as e:
+                manifests[cid] = str(e)
+        return manifests[cid]
+
+    def _verify_blob_closure(self, cid: int, fname: str,
+                             memo: Dict[Tuple[int, str], List[str]],
+                             manifests: Dict[int, Any]
+                             ) -> Tuple[List[str], int]:
+        """Problems for one blob AND everything it transitively refs or
+        deps on; ``memo`` keeps shared ancestors hashed once per sweep.
+        Returns (problems, physical bytes of this blob)."""
+        key = (cid, fname)
+        if key in memo:
+            return memo[key], 0
+        memo[key] = probs = []  # pre-seed: a cycle (impossible) ends
+        manifest = self._verify_manifest_of(cid, manifests)
+        if isinstance(manifest, str):
+            probs.append(f"{fname}: epoch {cid}: {manifest}")
+            return probs, 0
+        refs = manifest.get("refs") or {}
+        phys_cid = int(refs.get(fname, cid))
+        path = os.path.join(self._dirname(phys_cid), fname)
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(path)
+            got = _hash_file(path)
+        except OSError as e:
+            probs.append(f"{fname}: unreadable ({type(e).__name__}: {e})")
+            got = None
+        want = (manifest.get("digests") or {}).get(fname)
+        if want is not None and got is not None and got != want:
+            probs.append(f"{fname}: digest mismatch "
+                         f"(manifest {want}, file {got})")
+        for bcid in (manifest.get("deps") or {}).get(fname, []):
+            sub, _ = self._verify_blob_closure(int(bcid), fname,
+                                               memo, manifests)
+            for p in sub:
+                probs.append(f"{fname}: delta base epoch {bcid}: {p}"
+                             if not p.startswith(fname) else
+                             f"{fname}: delta base epoch {bcid}: "
+                             + p[len(fname) + 2:])
+        return probs, nbytes
 
     def quarantine(self, ckpt_id: int) -> Optional[str]:
         """Move a corrupt committed checkpoint out of the restore set by

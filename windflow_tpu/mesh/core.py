@@ -814,7 +814,10 @@ def sharded_grid_scan(mesh, func, filter_mode: bool, key_capacity: int,
                              k_local * M).astype(jnp.int32)
         touched = jnp.arange(k_local, dtype=jnp.int32)
         tmask = jnp.ones(k_local, bool)
-        out, table2 = core(rv, valid, grid_idx, touched, tmask, table)
+        # the mesh plane tracks touched slots host-side (_ckpt_dirty);
+        # the device bitmap is dropped and DCE'd out of the program
+        out, table2, _dirty2 = core(rv, valid, grid_idx, touched, tmask,
+                                    table, jnp.zeros((k_local,), bool))
         if filter_mode:
             keep = _route_back(ns, C, out.astype(jnp.int8), order, flat,
                                ok).astype(bool)

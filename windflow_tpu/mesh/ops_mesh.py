@@ -371,6 +371,15 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
         super().__init__(op, idx)
         self._table = None
         self._out_schema: Optional[TupleSchema] = None
+        # incremental checkpointing (WF_CKPT_DELTA): host-side dirty
+        # slot set — each batch and each tier promotion marks the global
+        # slot rows it rewrites, so a delta snapshot ships per-shard
+        # row patches instead of the whole sharded table
+        self._ckpt_dirty: set = set()
+        self._delta_base = None  # epoch id of the last full snapshot
+        self._snaps_since_full = 0
+        self._base_nkeys = None  # key count at the last full snapshot
+        self._base_geom = None  # (K_pad, n_shards) at the last full
         cfg = getattr(op, "tiering", None)
         if cfg is not None:
             if cfg.hot_capacity > op.key_capacity:
@@ -417,6 +426,7 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
             self._table = jax.tree_util.tree_unflatten(treedef, leaves)
             for k, s in zip(plan.promote_keys, plan.promote_slots):
                 self._key_by_slot[int(s)] = k
+            self._ckpt_dirty.update(int(s) for s in plan.promote_slots)
             tier.note_promote(len(plan.promote_keys),
                               (time.perf_counter() - t0) * 1e6)
 
@@ -458,6 +468,10 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
         if n == 0:
             return
         slots, keys_raw = self._batch_slots(batch)
+        from ..checkpoint.delta import env_ckpt_delta
+        if env_ckpt_delta():
+            # every slot row this batch scans through is dirty vs base
+            self._ckpt_dirty.update(np.unique(slots).tolist())
         cols = {f: np.asarray(batch.fields[f])[:n]
                 for f in self._val_fields}
         ts = np.asarray(batch.ts_host[:n])
@@ -515,6 +529,93 @@ class _MeshScanReplicaBase(_MeshReplicaBase):
         return warmed
 
     # -- sharded fault tolerance ----------------------------------------
+    def snapshot_state(self) -> dict:
+        from ..checkpoint import delta as ckpt_delta
+
+        ctx = ckpt_delta.snapshot_ctx()
+        if (self._mesh is not None and self._table is not None
+                and self._base_geom == (self._K_pad, self._ns)
+                and ckpt_delta.delta_eligible(
+                    self._delta_base, self._snaps_since_full, ctx)):
+            # DELTA: the TPUReplicaBase part (drain + generic fields)
+            # still captures fully; only the mesh_scan entry shrinks to
+            # per-shard patches of the dirty slot rows
+            st = TPUReplicaBase.snapshot_state(self)
+            self._snaps_since_full += 1
+            st[self._STATE_KEY] = self._snapshot_mesh_delta()
+            return st
+        st = super().snapshot_state()
+        if (ctx is not None and ckpt_delta.env_ckpt_delta()
+                and self._mesh is not None and self._table is not None):
+            # this full capture is the new delta baseline
+            self._delta_base = ctx.ckpt_id
+            self._base_geom = (self._K_pad, self._ns)
+            self._base_nkeys = len(self._keymap.slot_of_key)
+            self._snaps_since_full = 0
+            self._ckpt_dirty = set()
+            if self._tier is not None:
+                self._tier.wal_reset()
+        return st
+
+    def _snapshot_mesh_delta(self) -> dict:
+        """Delta against the last full snapshot: ONE cross-shard gather
+        of the dirty slot rows, split into per-shard local-row patches
+        (shard s owns global rows [s*k_local, (s+1)*k_local))."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..checkpoint import delta as ckpt_delta
+
+        sl = np.asarray(sorted(self._ckpt_dirty), dtype=np.int64)
+        kl = self._k_local
+        leaves, _ = jax.tree_util.tree_flatten(self._table)
+        jsl = jnp.asarray(sl)
+        rows = [np.asarray(jax.device_get(lf[jsl])) for lf in leaves]
+        shard_of = sl // kl if len(sl) else sl
+        patches: List[Optional[dict]] = []
+        for s in range(self._ns):
+            m = shard_of == s
+            if not len(sl) or not m.any():
+                patches.append(None)
+                continue
+            patches.append({"slots": sl[m] - s * kl,
+                            "leaves": [r[m] for r in rows]})
+        repl = {"key_capacity": self.op.key_capacity,
+                "K_pad": self._K_pad, "n_shards": self._ns,
+                "local_batch": self._local_batch,
+                "val_dtypes": {f: dt.str
+                               for f, dt in self._val_dtypes.items()}}
+        rows = {}
+        carry = []
+        if (self._tier is None
+                and len(self._keymap.slot_of_key) == self._base_nkeys):
+            # no key registered since the base: the directory (and its
+            # device twin by-slot column) is a zero-byte carry. Slots
+            # are append-only without tiering; tier swaps remap at
+            # constant size, so never carry there.
+            carry += ["slot_of_key", "key_by_slot"]
+        else:
+            repl["slot_of_key"] = dict(self._keymap.slot_of_key)
+            rows["key_by_slot"] = {
+                "slots": sl, "leaves": [self._key_by_slot[sl].copy()]}
+        node = ckpt_delta.make_delta(
+            self._delta_base, rows=rows or None,
+            shards={"table_shards": patches},
+            replace=repl, carry=carry or None)
+        if self._tier is not None:
+            node["replace"]["tier"] = self._tier.snapshot_delta(
+                self._delta_base)
+        return node
+
+    def restore_state(self, state: dict) -> None:
+        # restored state starts a fresh delta lineage
+        self._ckpt_dirty = set()
+        self._delta_base = None
+        self._snaps_since_full = 0
+        self._base_geom = None
+        self._base_nkeys = None
+        super().restore_state(state)
+
     def _snapshot_extra(self) -> dict:
         if self._tier is None:
             return {}

@@ -181,6 +181,9 @@ _PROM_SCALARS = (
     ("windflow_checkpoint_align_stall_seconds_total", "counter",
      "Time multi-input workers stalled aligning checkpoint barriers",
      "Checkpoint_align_stall_usec_total", 1e-6),
+    ("windflow_checkpoint_cut_pause_seconds", "counter",
+     "Time the barrier actually fenced the worker (state cut + ack; "
+     "excludes async uploads)", "Checkpoint_cut_pause_usec_total", 1e-6),
     ("windflow_sink_txn_precommits_total", "counter",
      "Exactly-once sink epochs pre-committed at the aligned barrier",
      "Sink_txn_precommits", 1),
@@ -350,6 +353,17 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
         ("windflow_ckpt_storage_failures_total", "counter",
          "Checkpoint epochs aborted by an OSError while staging blobs",
          "Checkpoint_storage_failures", 1),
+        # incremental + async checkpointing (WF_CKPT_DELTA / WF_CKPT_ASYNC)
+        ("windflow_checkpoint_delta_bytes_total", "counter",
+         "Physical bytes of delta-form checkpoint blobs (dirty rows + "
+         "WAL; unchanged ref'd shards cost zero)",
+         "Checkpoint_delta_bytes", 1),
+        ("windflow_checkpoint_async_uploads_total", "counter",
+         "Background snapshot uploads completed by the coordinator's "
+         "uploader", "Checkpoint_async_uploads", 1),
+        ("windflow_checkpoint_async_pending", "gauge",
+         "Async snapshot uploads currently in flight",
+         "Checkpoint_async_pending", 1),
     )
     for fam, typ, help_, field, scale in _CKPT_FAMS:
         body = []

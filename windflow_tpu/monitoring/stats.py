@@ -51,6 +51,11 @@ class StatsRecord:
         "checkpoints_taken", "checkpoint_snapshot_total_us",
         "checkpoint_last_snapshot_us", "checkpoint_bytes_total",
         "checkpoint_align_total_us",
+        # barrier CUT pause: how long the worker was actually fenced by
+        # the barrier (capture + ack). Equals snapshot time in sync
+        # mode; with WF_CKPT_ASYNC it excludes serialization + writes,
+        # which run on the coordinator's background uploader
+        "checkpoint_cut_total_us", "checkpoint_last_cut_us",
         # exactly-once sinks (windflow_tpu.sinks.transactional): per-epoch
         # two-phase-commit accounting — pre-commits at the barrier,
         # commits on coordinator finalize, aborts on restore/duplicate
@@ -156,6 +161,8 @@ class StatsRecord:
         self.checkpoint_last_snapshot_us = 0.0
         self.checkpoint_bytes_total = 0
         self.checkpoint_align_total_us = 0.0
+        self.checkpoint_cut_total_us = 0.0
+        self.checkpoint_last_cut_us = 0.0
         self.txn_precommits = 0
         self.txn_commits = 0
         self.txn_aborts = 0
@@ -303,15 +310,22 @@ class StatsRecord:
 
     # -- checkpointing (windflow_tpu.checkpoint) -----------------------------
     def note_checkpoint(self, snapshot_us: float, nbytes: int,
-                        align_us: float) -> None:
+                        align_us: float,
+                        cut_us: Optional[float] = None) -> None:
         """One aligned snapshot of this replica's worker chain:
-        state-capture duration, blob bytes written, and how long barrier
-        alignment stalled the chain (0 for single-input workers)."""
+        state-capture duration, blob bytes written, how long barrier
+        alignment stalled the chain (0 for single-input workers), and
+        the barrier CUT pause (capture + ack; defaults to the snapshot
+        duration for call sites that don't distinguish the two)."""
+        if cut_us is None:
+            cut_us = snapshot_us
         self.checkpoints_taken += 1
         self.checkpoint_snapshot_total_us += snapshot_us
         self.checkpoint_last_snapshot_us = snapshot_us
         self.checkpoint_bytes_total += nbytes
         self.checkpoint_align_total_us += align_us
+        self.checkpoint_cut_total_us += cut_us
+        self.checkpoint_last_cut_us = cut_us
         if self.recorder is not None:
             if align_us > 0:
                 self.recorder.event("barrier_align", align_us)
@@ -440,6 +454,10 @@ class StatsRecord:
             "Checkpoint_bytes_total": self.checkpoint_bytes_total,
             "Checkpoint_align_stall_usec_total": round(
                 self.checkpoint_align_total_us, 1),
+            "Checkpoint_cut_pause_usec_total": round(
+                self.checkpoint_cut_total_us, 1),
+            "Checkpoint_cut_pause_usec": round(
+                self.checkpoint_last_cut_us, 1),
             # exactly-once sink 2PC (0s unless with_exactly_once)
             "Sink_txn_precommits": self.txn_precommits,
             "Sink_txn_commits": self.txn_commits,

@@ -113,6 +113,7 @@ function render(snap){
       `<td>${fmt(m("Service_time_usec"))}</td>`+
       `<td>${fmt(m("Latency_service_p99_usec"))}</td>`+
       `<td>${fmt(m("Latency_e2e_p99_usec"))}</td>`+
+      `<td>${fmt(m("Checkpoint_cut_pause_usec"))}</td>`+
       `<td>${fmt(m("Queue_len"))}/${fmt(m("Queue_depth_max"))}</td>`+
       `<td>${fmt(s("Device_programs_run"))}</td>`+
       `<td>${fmt(s("Compile_count"))}/${fmt(s("Compile_cache_hits"))}</td>`+
@@ -127,6 +128,7 @@ function render(snap){
           `<td>${fmt(x.Service_time_usec)}</td>`+
           `<td>${fmt(x.Latency_service_p99_usec)}</td>`+
           `<td>${fmt(x.Latency_e2e_p99_usec)}</td>`+
+          `<td>${fmt(x.Checkpoint_cut_pause_usec)}</td>`+
           `<td>${fmt(x.Queue_len)}/${fmt(x.Queue_depth_max)}</td>`+
           `<td>${fmt(x.Device_programs_run)}</td>`+
           `<td title="${esc(x.Compile_last_signature||"")}">`+
@@ -136,7 +138,9 @@ function render(snap){
   el("ops").innerHTML =
     `<table><tr><th class=l>operator</th><th class=l>kind</th><th>par</th>`+
     `<th>in</th><th>out</th><th>ignored</th><th>tuples/s</th>`+
-    `<th>svc µs</th><th>svc p99</th><th>e2e p99</th><th>queue</th>`+
+    `<th>svc µs</th><th>svc p99</th><th>e2e p99</th>`+
+    `<th title="barrier cut pause (state capture + ack) of the last `+
+    `checkpoint">cut µs</th><th>queue</th>`+
     `<th>device progs</th><th>compiles/hits</th><th>pool hits</th></tr>`+
     rows.join("")+`</table>`+
     `<div class=muted>click an operator row for per-replica detail; `+

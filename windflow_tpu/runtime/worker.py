@@ -317,13 +317,32 @@ class Worker(threading.Thread):
             hook = getattr(node, "precommit_epoch", None)
             if hook is not None:
                 hook(barrier.ckpt_id)
-        nbytes = coord.ack(barrier.ckpt_id, self.name,
-                           self._capture_blobs())
+        # the capture runs under the snapshot context: engines that
+        # track touched slots may emit delta-form states (WF_CKPT_DELTA)
+        # for THIS epoch against their last full snapshot. The capture
+        # is a copy (device_get / host copies), so in async mode
+        # (WF_CKPT_ASYNC) the ack returns as soon as the blobs are
+        # registered and the pause the barrier imposes ends HERE — the
+        # serialization + writes happen on the coordinator's uploader.
+        from ..checkpoint import delta as _ckpt_delta
+        with _ckpt_delta.capturing(barrier.ckpt_id, coord.store):
+            blobs = self._capture_blobs()
+        nbytes = coord.ack(barrier.ckpt_id, self.name, blobs)
+        cut_us = (time.perf_counter() - t0) * 1e6
         stats = self._stats()
         if stats is not None:
-            stats.note_checkpoint((time.perf_counter() - t0) * 1e6,
-                                  nbytes, stall_us)
+            stats.note_checkpoint(cut_us, nbytes, stall_us, cut_us=cut_us)
         if self.flightrec is not None:
+            self.flightrec.event("ckpt:cut", cut_us,
+                                 {"ckpt_id": barrier.ckpt_id,
+                                  "bytes": nbytes})
+            if _ckpt_delta.env_ckpt_delta():
+                ndelta = sum(1 for st in blobs.values()
+                             if _ckpt_delta.delta_bases(st))
+                if ndelta:
+                    self.flightrec.event("ckpt:delta", 0.0,
+                                         {"ckpt_id": barrier.ckpt_id,
+                                          "delta_blobs": ndelta})
             self.flightrec.event("ckpt_ack", 0.0,
                                  {"ckpt_id": barrier.ckpt_id,
                                   "bytes": nbytes})

@@ -144,6 +144,14 @@ class ColdStore:
         # (a demoted key is never already cold), so put/take deltas keep
         # the cache exact. None = unknown, recomputed lazily.
         self._count: Optional[int] = 0 if fresh else None
+        # write-ahead delta log (WF_CKPT_DELTA): puts/deletes since the
+        # last FULL checkpoint image, so an incremental snapshot ships
+        # the churn instead of the whole sqlite backup. Collapsed per
+        # key (a re-put cancels its delete and vice versa); disabled by
+        # default — the TieredKeyStore enables it when deltas are on.
+        self.wal_enabled = False
+        self._wal_puts: Dict[Any, Any] = {}
+        self._wal_dels: set = set()
 
     def put_rows(self, keys: List[Any], leaf_cols: List[np.ndarray]) -> None:
         """Batched demote write: ``leaf_cols[l][i]`` is leaf ``l`` of
@@ -152,10 +160,14 @@ class ColdStore:
         across batches."""
         if not keys:
             return
-        self.db.put_many(
-            (k, tuple(col[i] for col in leaf_cols))
-            for i, k in enumerate(keys))
+        rows = [(k, tuple(col[i] for col in leaf_cols))
+                for i, k in enumerate(keys)]
+        self.db.put_many(iter(rows))
         self.db._conn.commit()
+        if self.wal_enabled:
+            for k, row in rows:
+                self._wal_puts[k] = row
+                self._wal_dels.discard(k)
         if self._count is not None:
             self._count += len(keys)
 
@@ -182,9 +194,23 @@ class ColdStore:
                 cols[li][i] = v
         if taken:
             self.db.delete_many(taken)
+            if self.wal_enabled:
+                for k in taken:
+                    self._wal_dels.add(k)
+                    self._wal_puts.pop(k, None)
             if self._count is not None:
                 self._count -= len(taken)
         return cols, hits
+
+    # -- delta WAL (WF_CKPT_DELTA) ------------------------------------------
+    def wal_snapshot(self) -> Tuple[List[Tuple[Any, Any]], List[Any]]:
+        """(puts, deletes) accumulated since the last ``wal_reset`` —
+        the cold tier's churn relative to its last full image."""
+        return list(self._wal_puts.items()), list(self._wal_dels)
+
+    def wal_reset(self) -> None:
+        self._wal_puts.clear()
+        self._wal_dels.clear()
 
     def __len__(self) -> int:
         if self._count is None:
@@ -194,6 +220,7 @@ class ColdStore:
     def clear(self) -> None:
         self.db.clear()
         self._count = 0
+        self.wal_reset()
 
     def keys(self):
         return self.db.keys()
@@ -207,6 +234,7 @@ class ColdStore:
     def restore_bytes(self, data: bytes) -> None:
         self.db.restore_bytes(data)
         self._count = None
+        self.wal_reset()  # the restored image IS the new full baseline
 
     def close(self) -> None:
         self.db.close()
@@ -249,6 +277,28 @@ def build_tier_blob(policy: str, hot_capacity: int, free_slots,
     if hot_digest is not None:
         d["digests"]["hot"] = hot_digest
     return d
+
+
+def apply_tier_delta(base_blob: dict, node: dict) -> dict:
+    """Materialize a FULL tier sub-blob from a base epoch's full blob
+    plus a WAL delta node (``checkpoint.delta.make_tier_delta``): decode
+    the base cold image, replay the collapsed puts/deletes, rebuild the
+    image, and stamp a fresh cold digest (the delta blob itself is
+    pinned by the manifest's whole-blob digest; the per-tier digest is
+    recomputed over the reconstructed bytes)."""
+    items = dict(cold_items_from_image(base_blob.get("cold_image")
+                                       or cold_image_from_items([])))
+    for k in node.get("wal_dels", []):
+        items.pop(k, None)
+    for k, row in node.get("wal_puts", []):
+        items[k] = row
+    image = cold_image_from_items(list(items.items()))
+    out = dict(node.get("replace") or {})
+    out["cold_image"] = image
+    digests = dict(out.get("digests") or {})
+    digests["cold"] = _digest(image)
+    out["digests"] = digests
+    return out
 
 
 def cold_image_from_items(items) -> bytes:
@@ -302,6 +352,9 @@ class TieredKeyStore:
         self.tracker = make_cache(self.policy, 1 << 62)
         self.cold = ColdStore(f"{name}_{next(_store_seq)}",
                               db_dir=config.db_dir, fresh=True)
+        # incremental checkpoints need the cold tier's churn log
+        from ..checkpoint.delta import env_ckpt_delta
+        self.cold.wal_enabled = env_ckpt_delta()
         self.free_slots: List[int] = list(range(self.hot_capacity - 1,
                                                 -1, -1))
         self.stats = stats
@@ -438,6 +491,26 @@ class TieredKeyStore:
         if hot_digest is not None:
             d["digests"]["hot"] = hot_digest
         return d
+
+    def snapshot_delta(self, base_ckpt: int) -> dict:
+        """Incremental tier sub-blob: the cold tier as its WAL since the
+        last full image plus the (small) bookkeeping fields, patching
+        the ``base_ckpt`` epoch's full sub-blob at restore
+        (``apply_tier_delta``). No hot digest is recorded — the delta
+        path never materializes the full hot table on the host, and the
+        manifest's whole-blob digest still pins the delta itself."""
+        from ..checkpoint.delta import make_tier_delta
+        puts, dels = self.cold.wal_snapshot()
+        return make_tier_delta(base_ckpt, puts, dels, {
+            "policy": self.policy,
+            "hot_capacity": self.hot_capacity,
+            "free_slots": list(self.free_slots),
+            "order": list(self.tracker.eviction_order()),
+        })
+
+    def wal_reset(self) -> None:
+        """A FULL snapshot was just taken: it is the new delta baseline."""
+        self.cold.wal_reset()
 
     def restore(self, d: dict, hot_digest: Optional[str] = None) -> None:
         if int(d.get("hot_capacity", self.hot_capacity)) \

@@ -167,6 +167,19 @@ class FfatTPUReplica(TPUReplicaBase):
         self._ktable_kd = None
         self._ktable_dirty = True
         self.ignored = 0
+        # incremental checkpointing (WF_CKPT_DELTA): host-side dirty
+        # slot set — ingest and fire mark the rows they touch, and a
+        # delta snapshot ships only those rows of the per-slot arrays +
+        # forest. Any executed level REBUILD rewrites internal tree
+        # rows forest-wide, so it conservatively forces the next
+        # snapshot FULL via _dirty_all (ingest-only stretches between
+        # fires — the realistic accumulation regime — still delta).
+        self._ckpt_dirty: set = set()
+        self._dirty_all = False
+        self._delta_base = None  # epoch id of the last full snapshot
+        self._snaps_since_full = 0
+        self._base_nkeys = None  # key count at the last full snapshot
+        self._base_geom = None  # (K_cap, F, trees-allocated) at base
         # device forest (lazily shaped once the lift output is known)
         self.trees = None  # dict field -> (K_cap, 2F)
         self.tvalid = None  # (K_cap, 2F) bool
@@ -586,6 +599,7 @@ class FfatTPUReplica(TPUReplicaBase):
         self.trees, self.tvalid = prog(self.trees, self.tvalid)
         self.stats.device_programs_run += 1
         self._rebuild_dirty = False
+        self._dirty_all = True  # rebuild rewrote internal rows forest-wide
 
     # ==================================================================
     # host control plane
@@ -649,6 +663,7 @@ class FfatTPUReplica(TPUReplicaBase):
         if new_trees is not None:
             self.trees, self.tvalid = new_trees, new_tvalid
         self._ktable_dirty = True
+        self._dirty_all = True  # geometry changed under the delta base
 
     def _grow_ring(self, needed_span: int) -> None:
         """BUILD-THEN-COMMIT, like ``_grow_keys`` (F and the migrated
@@ -691,6 +706,7 @@ class FfatTPUReplica(TPUReplicaBase):
         # only leaves were carried over: internal levels need a rebuild
         # before any fire-only program may query them
         self._rebuild_dirty = True
+        self._dirty_all = True  # geometry changed under the delta base
 
     def _ensure_forest(self, sample_fields) -> None:
         if self.trees is not None:
@@ -749,6 +765,10 @@ class FfatTPUReplica(TPUReplicaBase):
             n_rows = n
             ts_rows = batch.ts_host[:n]
         slots = self._slots_of(keys, keys_arr, n_rows)
+        from ..checkpoint.delta import env_ckpt_delta
+        if env_ckpt_delta() and n_rows:
+            # every row this batch touches is dirty vs the delta base
+            self._ckpt_dirty.update(np.unique(slots).tolist())
         if op.win_type is WinType.TB:
             leaves = ts_rows // op.pane_len
         else:
@@ -900,6 +920,9 @@ class FfatTPUReplica(TPUReplicaBase):
         wid0 = self.fired[slots].copy()
         self.next_fire[slots] += k * self.slide_units
         self.fired[slots] += k
+        if self._ckpt_dirty or self._delta_base is not None:
+            # firing advances bookkeeping and evicts ring panes
+            self._ckpt_dirty.update(slots.tolist())
         return slots, start0, k, wid0, self.max_leaf[slots].copy()
 
     @staticmethod
@@ -1220,6 +1243,7 @@ class FfatTPUReplica(TPUReplicaBase):
                     f_pack, ktable, e_pack)
                 self._rebuild_dirty = False  # in-program rebuild covers
                 # every deferred ingest-only batch (full-forest rebuild)
+                self._dirty_all = True  # ... and rewrote internal rows
             else:
                 # drain iterations: fire-only program (no rebuild)
                 self.tvalid, qr, qv, wid_dev, key_dev = self._fire_step()(
@@ -1311,8 +1335,17 @@ class FfatTPUReplica(TPUReplicaBase):
     # compiled programs rebuild lazily after restore.
     def snapshot_state(self) -> dict:
         import jax
+        from ..checkpoint import delta as ckpt_delta
 
         st = super().snapshot_state()  # drains the dispatch queue
+        ctx = ckpt_delta.snapshot_ctx()
+        if (self.trees is not None and not self._dirty_all
+                and self._base_geom == (self.K_cap, self.F, True)
+                and ckpt_delta.delta_eligible(
+                    self._delta_base, self._snaps_since_full, ctx)):
+            self._snaps_since_full += 1
+            st["ffat"] = self._snapshot_ffat_delta()
+            return st
         st["ffat"] = {
             "slot_of_key": dict(self.slot_of_key),
             "out_keys_by_slot": list(self._out_keys_by_slot),
@@ -1334,10 +1367,69 @@ class FfatTPUReplica(TPUReplicaBase):
             "tvalid": (None if self.tvalid is None
                        else np.asarray(jax.device_get(self.tvalid))),
         }
+        if ctx is not None and ckpt_delta.env_ckpt_delta():
+            # this full capture is the new delta baseline (capture runs
+            # post-drain, so no in-flight commit can race the reset)
+            self._delta_base = ctx.ckpt_id
+            self._base_geom = (self.K_cap, self.F, self.trees is not None)
+            self._base_nkeys = len(self.slot_of_key)
+            self._snaps_since_full = 0
+            self._ckpt_dirty = set()
+            self._dirty_all = False
         return st
+
+    def _snapshot_ffat_delta(self) -> dict:
+        """Delta against the last full snapshot: only the dirty slot
+        rows of every per-slot array + forest plane, plus the (small)
+        replaced bookkeeping fields."""
+        import jax
+        import jax.numpy as jnp
+        from ..checkpoint import delta as ckpt_delta
+
+        sl = np.asarray(sorted(self._ckpt_dirty), dtype=np.int64)
+        rows = {
+            name: {"slots": sl, "leaves": [getattr(self, attr)[sl].copy()]}
+            for name, attr in (("next_fire", "next_fire"),
+                               ("fired", "fired"),
+                               ("max_leaf", "max_leaf"),
+                               ("count", "count"),
+                               ("keys_np", "_keys_np"))}
+        jsl = jnp.asarray(sl)
+        leaves, _ = jax.tree_util.tree_flatten(self.trees)
+        rows["trees"] = {"slots": sl, "leaves": [
+            np.asarray(jax.device_get(lf[jsl])) for lf in leaves]}
+        rows["tvalid"] = {"slots": sl, "leaves": [
+            np.asarray(jax.device_get(self.tvalid[jsl]))]}
+        repl = {"K_cap": self.K_cap, "F": self.F,
+                "keys_all_int": self._keys_all_int,
+                "key_dtype": self._key_dtype,
+                "saw_new_key": self._saw_new_key,
+                "leaf_frontier": self._leaf_frontier,
+                "fire_ewma": self._fire_ewma,
+                "rebuild_dirty": self._rebuild_dirty,
+                "ignored": self.ignored}
+        carry = []
+        if len(self.slot_of_key) == self._base_nkeys:
+            # slots are append-only between rebuilds (a rebuild sets
+            # _dirty_all, forcing a full snapshot), so an unchanged key
+            # count means an unchanged directory: zero-byte carry
+            carry += ["slot_of_key", "out_keys_by_slot"]
+        else:
+            repl["slot_of_key"] = dict(self.slot_of_key)
+            repl["out_keys_by_slot"] = list(self._out_keys_by_slot)
+        return ckpt_delta.make_delta(
+            self._delta_base, rows=rows, replace=repl,
+            carry=carry or None)
 
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
+        # restored state starts a fresh delta lineage
+        self._ckpt_dirty = set()
+        self._dirty_all = False
+        self._delta_base = None
+        self._snaps_since_full = 0
+        self._base_geom = None
+        self._base_nkeys = None
         d = state.get("ffat")
         if d is None:
             return

@@ -207,9 +207,10 @@ class FusedTPUReplica(TPUReplicaBase):
                     core = _grid_scan_core(spec.func,
                                            spec.kind == "sfilter", M, KB)
                     grid_idx, touched, tmask = hargs[i]
-                    out, t2 = core(fields, valid, grid_idx, touched,
-                                   tmask, tables[ti])
-                    new_tables.append(t2)
+                    tbl, dirty = tables[ti]
+                    out, t2, d2 = core(fields, valid, grid_idx, touched,
+                                       tmask, tbl, dirty)
+                    new_tables.append((t2, d2))
                     ti += 1
                     if spec.kind == "sfilter":
                         valid = out
@@ -397,13 +398,13 @@ class FusedTPUReplica(TPUReplicaBase):
         engines = self._engines
 
         def commit() -> None:
-            # tables read AT COMMIT TIME — earlier queued commits
-            # reassign them (donation)
-            tables = tuple(e.table for e in engines)
+            # tables (+ dirty bitmaps) read AT COMMIT TIME — earlier
+            # queued commits reassign them (donation)
+            tables = tuple((e.table, e.dirty) for e in engines)
             res = prog(batch.fields, batch.size, hargs_t, tables)
             self.stats.device_programs_run += 1  # ONE program per batch
-            for eng, t2 in zip(engines, res[-1]):
-                eng.table = t2
+            for eng, td in zip(engines, res[-1]):
+                eng.table, eng.dirty = td
             self._commit_emit(batch, res[:-1], kextra)
 
         # megabatch metadata: the dispatch queue groups consecutive
@@ -434,14 +435,17 @@ class FusedTPUReplica(TPUReplicaBase):
                               ("scan", key, cap, k),
                               lambda: self._make_scan(key, k))
         engines = self._engines
-        tables = tuple(e.table for e in engines)
+        tables = tuple((e.table, e.dirty) for e in engines)
         fields_t = tuple(p[0].fields for p in payloads)
         sizes = np.asarray([p[0].size for p in payloads], dtype=np.int32)
         hargs_tt = tuple(p[1] for p in payloads)
         per, new_tables = prog(fields_t, sizes, hargs_tt, tables)
         self.stats.device_programs_run += 1  # ONE program for K batches
-        for eng, t2 in zip(engines, new_tables):
-            eng.table = t2
+        # the scan carry threads (table, dirty) batch-to-batch, so a
+        # megabatch accumulates dirty bits across all K batches exactly
+        # like K sequential commits would
+        for eng, td in zip(engines, new_tables):
+            eng.table, eng.dirty = td
         for p, parts in zip(payloads, per):
             self._commit_emit(p[0], parts, p[2])
         self.stats.note_megabatch(k, (time.perf_counter() - t0) * 1e6)

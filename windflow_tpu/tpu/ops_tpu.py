@@ -214,9 +214,12 @@ def _grid_scan_core(func, filter_mode: bool, M: int, KB: int):
     scatter to a (KB x M) grid of (key slot, per-key position), a
     ``lax.scan`` walks the position axis while ``vmap`` covers the keys,
     and the results gather back to arrival positions. Returns
-    ``core(fields, valid, grid_idx, touched, touched_mask, table) ->
-    (out, table2)`` where ``out`` is the per-row output columns (map
-    mode) or the per-row keep mask ANDed with ``valid`` (filter mode).
+    ``core(fields, valid, grid_idx, touched, touched_mask, table, dirty)
+    -> (out, table2, dirty2)`` where ``out`` is the per-row output
+    columns (map mode) or the per-row keep mask ANDed with ``valid``
+    (filter mode) and ``dirty2`` is the touched-slot bitmap with this
+    grid's slots marked (rides the carry — incremental checkpoints
+    gather only dirty rows).
     ``valid`` may be a host bool array (standalone) or a traced
     device mask (fused chains: rows a mid-chain filter dropped skip the
     grid and leave their key's state untouched)."""
@@ -230,7 +233,7 @@ def _grid_scan_core(func, filter_mode: bool, M: int, KB: int):
         shaped = ok.reshape(ok.shape + (1,) * (new.ndim - ok.ndim))
         return jnp.where(shaped, new, old).astype(old.dtype)
 
-    def core(fields, valid, grid_idx, touched, touched_mask, table):
+    def core(fields, valid, grid_idx, touched, touched_mask, table, dirty):
         T_cap = next(iter(jax.tree_util.tree_leaves(table))).shape[0]
         tsafe = jnp.where(touched_mask, touched, 0)
         sub = tmap(lambda a: a[tsafe], table)  # (KB, ...)
@@ -254,18 +257,22 @@ def _grid_scan_core(func, filter_mode: bool, M: int, KB: int):
         table2 = tmap(
             lambda a, nw: a.at[tscatter].set(nw, mode="drop"),
             table, sub2)
+        # touched-slot bitmap: every slot this grid scattered back to is
+        # dirty since the last full snapshot (conservative — marked even
+        # when func left the value bit-identical)
+        dirty2 = dirty.at[tscatter].set(True, mode="drop")
         # gather outputs back to arrival positions: grid (slot, within)
         slot = grid_idx // M
         within = jnp.where(valid, grid_idx % M, 0)
         row_flat = within * KB + jnp.minimum(slot, KB - 1)
         if filter_mode:
             keep = outs.reshape(-1)[row_flat]  # (cap,)
-            return keep.astype(bool) & valid, table2
+            return keep.astype(bool) & valid, table2, dirty2
         out_rows = {f: (o.reshape(M * KB, -1)[row_flat].reshape(
                         fields[f].shape)
                         if o.ndim > 2 else o.reshape(-1)[row_flat])
                     for f, o in outs.items()}
-        return out_rows, table2
+        return out_rows, table2, dirty2
 
     return core
 
@@ -657,6 +664,15 @@ class _KeyedStateScan:
                 f"{self.op.name}_r{replica.idx}_tier", cfg,
                 stats=replica.stats)
             self.table_capacity = self.tier.hot_capacity
+        # incremental checkpointing (WF_CKPT_DELTA): a device-resident
+        # touched-slot bitmap rides the grid-scan carry, so a delta
+        # snapshot gathers only the rows dirtied since the last FULL
+        # snapshot (the delta base — always a full epoch, chain depth 1)
+        self.dirty = None  # (table_capacity,) bool, grown with the table
+        self._delta_base = None  # epoch id of the last full snapshot
+        self._snaps_since_full = 0
+        self._base_capacity = None  # capacity at the last full snapshot
+        self._base_nkeys = None  # key count at the last full snapshot
 
     # -- device program ----------------------------------------------------
     def _make(self, M: int, KB: int):
@@ -673,23 +689,25 @@ class _KeyedStateScan:
         core = _grid_scan_core(self.func, self.filter_mode, M, KB)
         filter_mode = self.filter_mode
 
-        def run(fields, grid_idx, valid, touched, touched_mask, table):
-            out, table2 = core(fields, valid, grid_idx, touched,
-                               touched_mask, table)
+        def run(fields, grid_idx, valid, touched, touched_mask, table,
+                dirty):
+            out, table2, dirty2 = core(fields, valid, grid_idx, touched,
+                                       touched_mask, table, dirty)
             if filter_mode:
                 keep = out
                 order = _compact_order(keep)  # keepers first, stable
                 outf = {k: v[order] for k, v in fields.items()}
-                return outf, order, jnp.sum(keep), table2
-            return out, table2
+                return outf, order, jnp.sum(keep), table2, dirty2
+            return out, table2, dirty2
 
-        # the state table is DONATED: the touched-row scatter updates it
-        # in place instead of copying the whole table every batch (the
-        # same double-buffer discipline as the FFAT forest — every call
-        # site reassigns self.table from the program output, so the
-        # consumed buffer is never reused)
+        # the state table (and its dirty bitmap) are DONATED: the
+        # touched-row scatter updates them in place instead of copying
+        # the whole table every batch (the same double-buffer discipline
+        # as the FFAT forest — every call site reassigns self.table /
+        # self.dirty from the program output, so the consumed buffers are
+        # never reused)
         return instrumented_jit(run, self.replica.stats,
-                                label=self.op.name, donate_argnums=(5,))
+                                label=self.op.name, donate_argnums=(5, 6))
 
     # -- host side ---------------------------------------------------------
     def _ensure_table(self, n_keys_needed: int) -> None:
@@ -701,6 +719,7 @@ class _KeyedStateScan:
             self.table = jax.tree_util.tree_map(
                 lambda v: jnp.full((self.table_capacity,), v,
                                    dtype=jnp.asarray(v).dtype), init)
+        self._sync_dirty()
         if self.tier is not None:
             # tiered mode: the device table IS the hot tier, fixed at
             # hot_capacity — keys beyond it spill to the cold store via
@@ -724,6 +743,23 @@ class _KeyedStateScan:
                 self.state_init)
             self.table = jax.tree_util.tree_map(
                 lambda f, o: f.at[:o.shape[0]].set(o), fresh, old)
+        self._sync_dirty()
+
+    def _sync_dirty(self) -> None:
+        """Keep the dirty bitmap allocated and shape-matched to the
+        table. Growth carries the old bits over — the grown rows hold
+        initial state and get marked when first touched (and growth
+        changes capacity, which already forces the next snapshot FULL)."""
+        import jax.numpy as jnp
+
+        if self.table is None:
+            return
+        if self.dirty is None:
+            self.dirty = jnp.zeros((self.table_capacity,), bool)
+        elif int(self.dirty.shape[0]) != self.table_capacity:
+            old = self.dirty
+            self.dirty = (jnp.zeros((self.table_capacity,), bool)
+                          .at[:old.shape[0]].set(old))
 
     def grid_meta(self, batch: BatchTPU):
         """(grid_idx, valid, touched, touched_mask, M, KB): batch-local
@@ -811,6 +847,9 @@ class _KeyedStateScan:
                 leaves = [lf.at[pslots].set(jnp.asarray(col))
                           for lf, col in zip(leaves, cols)]
                 self.table = jax.tree_util.tree_unflatten(treedef, leaves)
+                if self.dirty is not None:
+                    # promoted rows differ from the delta base's hot tier
+                    self.dirty = self.dirty.at[pslots].set(True)
                 tier.note_promote(len(plan.promote_keys),
                                   (time.perf_counter() - t0) * 1e6)
 
@@ -824,6 +863,35 @@ class _KeyedStateScan:
     # from the restored dict, and compiled programs re-trace on demand.
     def snapshot_state(self) -> dict:
         import jax
+        import jax.numpy as jnp
+        from ..checkpoint import delta as ckpt_delta
+
+        ctx = ckpt_delta.snapshot_ctx()
+        if (self.table is not None and self.dirty is not None
+                and self._base_capacity == self.table_capacity
+                and ckpt_delta.delta_eligible(
+                    self._delta_base, self._snaps_since_full, ctx)):
+            # DELTA: gather only the rows dirtied since the last full
+            # snapshot — cost scales with the touched set, not capacity
+            self._snaps_since_full += 1
+            repl, carry = {}, []
+            if (self.tier is None
+                    and len(self.slot_of_key) == self._base_nkeys):
+                # no key registered since the base: the directory rides
+                # as a zero-byte carry, not a re-pickle of every key.
+                # Dense slots are append-only, so an unchanged count
+                # means an unchanged mapping; under tiering demote /
+                # promote swaps remap at constant size, so never carry.
+                carry += ["slot_of_key", "table_capacity"]
+            else:
+                repl["slot_of_key"] = dict(self.slot_of_key)
+                repl["table_capacity"] = self.table_capacity
+            if self.tier is not None:
+                repl["tier"] = self.tier.snapshot_delta(self._delta_base)
+            return ckpt_delta.make_delta(
+                self._delta_base,
+                rows={"table": self._dirty_rows()},
+                replace=repl or None, carry=carry or None)
         table = (None if self.table is None
                  else jax.device_get(self.table))
         d = {"slot_of_key": dict(self.slot_of_key),
@@ -833,11 +901,41 @@ class _KeyedStateScan:
             from ..state.tiered import hot_table_digest
             d["tier"] = self.tier.snapshot(
                 hot_digest=hot_table_digest(table))
+        if ctx is not None and ckpt_delta.env_ckpt_delta():
+            # this full capture is the new delta baseline; the bitmap
+            # and the cold store's WAL restart from it (capture runs
+            # post-drain, so no in-flight commit can race the reset)
+            self._delta_base = ctx.ckpt_id
+            self._base_capacity = self.table_capacity
+            self._base_nkeys = len(self.slot_of_key)
+            self._snaps_since_full = 0
+            if self.table is not None:
+                self.dirty = jnp.zeros((self.table_capacity,), bool)
+            if self.tier is not None:
+                self.tier.wal_reset()
         return d
+
+    def _dirty_rows(self) -> dict:
+        """Host copies of just the dirty slot rows, one gathered column
+        per table leaf (tree_flatten order — matches delta._apply_rows)."""
+        import jax
+
+        dirty_np = np.asarray(jax.device_get(self.dirty)).astype(bool)
+        slots = np.nonzero(dirty_np)[0].astype(np.int64)
+        leaves, _ = jax.tree_util.tree_flatten(self.table)
+        rows = [np.asarray(jax.device_get(lf[slots])) for lf in leaves]
+        return {"slots": slots, "leaves": rows}
 
     def restore_state(self, state: dict) -> None:
         import jax
 
+        # restored state starts a fresh delta lineage: the next capture
+        # is FULL and re-establishes base/bitmap/WAL
+        self.dirty = None
+        self._delta_base = None
+        self._snaps_since_full = 0
+        self._base_capacity = None
+        self._base_nkeys = None
         tier_blob = state.get("tier")
         if tier_blob is not None and self.tier is None:
             raise WindFlowError(
@@ -906,10 +1004,12 @@ class StatefulMapTPUReplica(TPUReplicaBase):
         prog = self.engine.program(M, KB)
 
         def commit() -> None:
-            outs, table2 = prog(batch.fields, grid_idx, valid, touched,
-                                tmask, self.engine.table)
+            outs, table2, dirty2 = prog(batch.fields, grid_idx, valid,
+                                        touched, tmask, self.engine.table,
+                                        self.engine.dirty)
             self.stats.device_programs_run += 1
             self.engine.table = table2
+            self.engine.dirty = dirty2
             self._emit_batch(batch.with_fields(outs))
 
         return commit
@@ -938,11 +1038,12 @@ class StatefulFilterTPUReplica(TPUReplicaBase):
         prog = self.engine.program(M, KB)
 
         def commit() -> None:
-            out, order, count, table2 = prog(
+            out, order, count, table2, dirty2 = prog(
                 batch.fields, grid_idx, valid, touched, tmask,
-                self.engine.table)
+                self.engine.table, self.engine.dirty)
             self.stats.device_programs_run += 1
             self.engine.table = table2
+            self.engine.dirty = dirty2
             # emit_compacted's int(count)/np.asarray(order) readbacks run
             # here, depth batches after dispatch — no fresh-result stall
             self.emit_compacted(batch, out, order, count)
