@@ -122,7 +122,29 @@ REQUIRED_FAMILIES = (
     "windflow_tier_demotes_total",
     "windflow_tier_promote_seconds_total",
     "windflow_tier_miss_rate",
+    # event-time health plane (a fifth graph runs an EVENT_TIME keyed
+    # window over a 5%-late stream into a deliberately slow sink, so the
+    # watermark gauges, late counters, the lateness histogram AND the
+    # pipeline doctor all carry real samples)
+    "windflow_watermark_timestamp_usec",
+    "windflow_watermark_advances_total",
+    "windflow_watermark_lag_seconds",
+    "windflow_watermark_event_lag_seconds",
+    "windflow_watermark_idle",
+    "windflow_watermark_stalls_total",
+    "windflow_late_records_total",
+    "windflow_late_dropped_total",
+    "windflow_late_admitted_total",
+    "windflow_lateness_usec",
+    "windflow_doctor_healthy",
+    "windflow_doctor_findings",
 )
+
+# verdict vocabulary shared with monitoring/doctor.py (schema check of
+# the /doctor smoke below)
+_DOCTOR_VERDICTS = frozenset((
+    "ingest-bound", "compute-bound", "dispatch-bound", "backpressured-by",
+    "event-time-stalled", "overloaded"))
 
 _SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s+'
@@ -313,6 +335,100 @@ def run_tiered_graph():
         "tiered map reported no promotes"
 
 
+def run_event_time_graph(host: str, http_port: int) -> list:
+    """The event-time health leg: an EVENT_TIME source whose stream is
+    5% late (50 ms behind a watermark with zero allowed lateness) feeds
+    a keyed time window into a DELIBERATELY SLOW sink. While it runs,
+    poll ``GET /doctor`` and schema-check the diagnosis: the doctor must
+    emit at least one finding with a verdict from the shared vocabulary
+    (the slow sink is the planted bottleneck). Returns problem strings
+    (empty = OK); also leaves Late_* / Watermark_* / lateness-histogram
+    samples behind for the family checks."""
+    import threading
+    import time as _time
+
+    from windflow_tpu import (ExecutionMode, Keyed_Windows_Builder,
+                              PipeGraph, Sink_Builder, Source_Builder,
+                              TimePolicy)
+
+    lateness_us = 50_000
+
+    def src(shipper):
+        ts = 0
+        for i in range(40_000):
+            ts += 25  # synthetic event clock: 1 s of event time total
+            late = (i % 20) == 7  # deterministic 5% late share
+            shipper.push_with_timestamp(
+                {"k": i % 8, "v": i}, ts - lateness_us if late else ts)
+            if (i % 100) == 99:
+                shipper.set_next_watermark(ts)
+
+    fired = [0]
+
+    def slow_sink(res):
+        if res is not None:
+            fired[0] += 1
+            _time.sleep(0.004)  # the planted bottleneck
+
+    g = PipeGraph("check_metrics_event_time", ExecutionMode.DEFAULT,
+                  TimePolicy.EVENT_TIME)
+    g.add_source(Source_Builder(src).with_name("esrc").build()) \
+        .add(Keyed_Windows_Builder(lambda ws: len(list(ws)))
+             .with_key_by(lambda t: t["k"])
+             .with_tb_windows(2_000, 2_000)  # 500 fires over the stream
+             .with_name("ewin").build()) \
+        .add_sink(Sink_Builder(slow_sink).with_name("eout").build())
+    problems = []
+    g.start()
+    # the server diagnoses each 1 Hz report; poll /doctor until this
+    # graph's diagnosis lands (two reports give the first tick delta)
+    diag = None
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{http_port}/doctor", timeout=5) as r:
+                doc = json.load(r)
+            diag = doc.get("check_metrics_event_time")
+            if diag and diag.get("findings"):
+                break
+        except urllib.error.HTTPError as e:
+            if e.code != 503:  # 503 = no tick delta yet; keep polling
+                raise
+        _time.sleep(0.25)
+    g.wait_end()
+    if not isinstance(diag, dict):
+        return ["/doctor never produced a diagnosis for the slow-sink "
+                "graph"]
+    for k in ("healthy", "findings", "summary", "dt_sec", "bottleneck"):
+        if k not in diag:
+            problems.append(f"/doctor diagnosis missing key {k!r}")
+    finds = diag.get("findings") or []
+    if not finds:
+        problems.append("/doctor found nothing on a graph with a "
+                        "deliberately slow sink")
+    for f in finds:
+        if f.get("verdict") not in _DOCTOR_VERDICTS:
+            problems.append(f"/doctor verdict {f.get('verdict')!r} not "
+                            f"in the shared vocabulary")
+        if not f.get("operator") or "evidence" not in f:
+            problems.append(f"/doctor finding missing operator/evidence: "
+                            f"{f}")
+    # the planted bottleneck is the sink: the top finding must name it
+    # (either directly or as the backpressured-by target)
+    top = diag.get("bottleneck") or {}
+    if finds and top.get("operator") != "eout" \
+            and top.get("by") != "eout":
+        problems.append(f"/doctor blamed {top.get('operator')!r}, not "
+                        f"the slow sink: {diag.get('summary')}")
+    # late accounting: the 5%-late stream must be visible in the stats
+    ewin = [o for o in g.get_stats()["Operators"]
+            if o["name"] == "ewin"][0]["replicas"]
+    if sum(r.get("Late_records", 0) for r in ewin) == 0:
+        problems.append("event-time leg recorded no late tuples")
+    return problems
+
+
 def run_graph_and_scrape():
     """Run the tiny graph against a fresh server; return (metrics text,
     /trace document, pre-run /metrics status code)."""
@@ -408,6 +524,9 @@ def run_graph_and_scrape():
         # the tiered-state leg: the key set overflows the hot tier so
         # the windflow_tier_* families carry non-zero samples
         run_tiered_graph()
+        # the event-time health leg: 5%-late stream + slow sink; polls
+        # /doctor live and leaves Late_*/Watermark_* samples behind
+        doctor_problems = run_event_time_graph(server.host, http_port)
         # the final report is flushed by the monitor thread at stop but
         # consumed by the server's reader thread: wait for it to land
         import time
@@ -417,7 +536,8 @@ def run_graph_and_scrape():
             if "check_metrics" in reports \
                     and "check_metrics_mesh" in reports \
                     and "check_metrics_columnar" in reports \
-                    and "check_metrics_tiered" in reports:
+                    and "check_metrics_tiered" in reports \
+                    and "check_metrics_event_time" in reports:
                 break
             time.sleep(0.05)
         else:
@@ -437,14 +557,14 @@ def run_graph_and_scrape():
                 f"http://{server.host}:{http_port}/trace?ms=50",
                 timeout=10) as r:
             trace_doc = json.load(r)
-        return text, trace_doc, pre_status
+        return text, trace_doc, pre_status, doctor_problems
     finally:
         server.close()
 
 
 def main() -> int:
-    text, trace_doc, pre_status = run_graph_and_scrape()
-    problems = []
+    text, trace_doc, pre_status, doctor_problems = run_graph_and_scrape()
+    problems = list(doctor_problems)
     if pre_status != 503:
         problems.append(f"pre-run /metrics returned {pre_status}, want 503")
     problems.extend(f"/trace: {e}"
@@ -453,7 +573,8 @@ def main() -> int:
         if f"\n# TYPE {fam} " not in "\n" + text:
             problems.append(f"missing required family: {fam}")
     problems.extend(validate_exposition(text))
-    for fam in ("windflow_service_latency_usec", "windflow_e2e_latency_usec"):
+    for fam in ("windflow_service_latency_usec", "windflow_e2e_latency_usec",
+                "windflow_lateness_usec"):
         problems.extend(check_histogram_consistency(text, fam))
     # the sampled run must produce non-zero end-to-end latency evidence
     m = re.search(r'windflow_e2e_latency_usec_count\{[^}]*operator="out'

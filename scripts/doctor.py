@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Pipeline-doctor CLI: bottleneck attribution from the terminal.
+
+Two modes:
+
+- live:     ``python scripts/doctor.py --url http://127.0.0.1:PORT``
+            fetches ``GET /doctor`` from a running MonitoringServer (the
+            server diagnoses every 1 Hz report tick); ``--watch`` polls.
+            If the server predates the /doctor endpoint (404) the CLI
+            falls back to polling ``/json`` twice and diagnosing the two
+            reports locally.
+- snapshot: ``python scripts/doctor.py --snapshot dump.json [--dt SEC]``
+            diagnoses a dumped stats snapshot offline — either a full
+            ``/json`` snapshot (``{"reports": {...}}``) or a single
+            graph's ``get_stats()`` dict. With one snapshot there is no
+            tick delta, so the analysis runs in whole-run cumulative
+            mode: pass the real run duration via ``--dt`` for correct
+            rate fractions.
+
+``--json`` emits the raw diagnosis document instead of the text report.
+Exit code: 0 when every diagnosed graph is healthy, 1 when any graph has
+findings, 2 on usage/connection errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from windflow_tpu.monitoring.doctor import (PipelineDoctor, diagnose,  # noqa: E402
+                                            render_text)
+
+
+def _fetch(url: str):
+    with urllib.request.urlopen(url, timeout=5.0) as r:
+        return json.loads(r.read().decode())
+
+
+def _diagnose_live(base: str, interval: float):
+    """GET /doctor; on 404 (older server) fall back to two /json polls
+    diagnosed locally."""
+    try:
+        return _fetch(base + "/doctor")
+    except urllib.error.HTTPError as e:
+        if e.code == 503:
+            # server is up but has a single report: wait one tick for a
+            # delta instead of failing the invocation
+            time.sleep(interval)
+            return _fetch(base + "/doctor")
+        if e.code != 404:
+            raise
+    pd = PipelineDoctor()
+    for g, st in (_fetch(base + "/json").get("reports") or {}).items():
+        pd.observe(g, st)
+    time.sleep(interval)
+    out = {}
+    for g, st in (_fetch(base + "/json").get("reports") or {}).items():
+        d = pd.observe(g, st)
+        if d is not None:
+            out[g] = d
+    return out
+
+
+def _diagnose_snapshot(path: str, dt: float):
+    with open(path) as f:
+        doc = json.load(f)
+    reports = doc.get("reports") if isinstance(doc.get("reports"), dict) \
+        else {doc.get("name", os.path.basename(path)): doc}
+    return {g: diagnose(None, st, dt)
+            for g, st in reports.items() if isinstance(st, dict)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="MonitoringServer HTTP base, e.g. "
+                     "http://127.0.0.1:8080")
+    src.add_argument("--snapshot", help="dumped /json snapshot or "
+                     "get_stats() JSON file")
+    ap.add_argument("--dt", type=float, default=60.0,
+                    help="run duration for snapshot (cumulative) mode "
+                    "[%(default)ss]")
+    ap.add_argument("--interval", type=float, default=1.5,
+                    help="poll interval for --watch / the /json "
+                    "fallback [%(default)ss]")
+    ap.add_argument("--watch", action="store_true",
+                    help="keep polling the live endpoint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw diagnosis JSON")
+    args = ap.parse_args(argv)
+
+    def once():
+        if args.snapshot:
+            return _diagnose_snapshot(args.snapshot, args.dt)
+        return _diagnose_live(args.url.rstrip("/"), args.interval)
+
+    try:
+        while True:
+            diags = once()
+            if args.as_json:
+                print(json.dumps(diags, indent=1))
+            elif not diags:
+                print("doctor: no graphs diagnosed "
+                      "(no reports, or only one tick so far)")
+            else:
+                for g, d in diags.items():
+                    print(render_text(d, g))
+            if not args.watch or args.snapshot:
+                return 0 if diags and all(
+                    d.get("healthy") for d in diags.values()) else \
+                    (1 if diags else 2)
+            time.sleep(args.interval)
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        print(f"doctor: cannot read input: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Perf-trend regression tracker over the repo's recorded bench rounds.
+
+The repo accumulates one ``BENCH_r0N.json`` + ``MULTICHIP_r0N.json`` per
+growth round (driver-recorded ``bench.py`` / ``bench_mesh`` results) plus
+one-off result documents under ``results/``. This tool turns that pile
+into a trend table and a regression gate:
+
+- for every tracked metric, the LATEST round is compared against the
+  BEST prior round that recorded the metric;
+- a throughput metric that dropped more than ``--threshold`` (default
+  10%) — or a latency metric that ROSE more than it — is a regression;
+- any regression exits non-zero, so the check can gate a commit:
+  ``python scripts/perf_trend.py`` (add ``--json`` for machine output).
+
+Rounds flagged ``contended_by_relay_client`` are listed but never used
+as a comparison baseline and never fail the gate (a contended bench run
+measures the contention, not the code). ``results/*.json`` documents are
+unversioned one-offs: their headline metrics are reported for context
+but not trended.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# (metric key path, higher_is_better) per document family; a missing key
+# in a round simply leaves that round out of the metric's trend
+_BENCH_METRICS = (
+    ("value", True),
+    ("tuples_per_sec_16k_batches", True),
+    ("hc_tuples_per_sec", True),
+    ("hc_sparse_wm_tuples_per_sec", True),
+    ("stateful_map_tuples_per_sec", True),
+    ("keyed_reduce_tuples_per_sec", True),
+    ("mesh_tuples_per_sec", True),
+    ("windows_per_sec", True),
+    ("p99_window_fire_latency_us", False),
+)
+_MULTICHIP_METRICS = (
+    ("value", True),
+    ("windows_per_sec", True),
+    ("sharded_scan.tuples_per_sec", True),
+    ("sharded_reduce.tuples_per_sec", True),
+)
+
+
+def _get(doc, path):
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _load_rounds(root: str, pattern: str):
+    """[(round_number, doc)] sorted by round number."""
+    rounds = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rounds.append((int(m.group(1)), json.load(f)))
+        except (OSError, json.JSONDecodeError):
+            continue
+    rounds.sort(key=lambda t: t[0])
+    return rounds
+
+
+def _trend(series_name, rounds, metrics, threshold):
+    """Trend rows + regressions for one round family."""
+    rows, regressions = [], []
+    usable = [(n, d, bool(_get(d, "contended_by_relay_client")))
+              for n, d in rounds]
+    for key, higher_better in metrics:
+        points = [(n, _get(d, key), contended)
+                  for n, d, contended in usable
+                  if _get(d, key) is not None]
+        if len(points) < 2:
+            continue
+        latest_n, latest_v, latest_cont = points[-1]
+        prior = [(n, v) for n, v, cont in points[:-1] if not cont]
+        if not prior:
+            continue
+        best_n, best_v = (max(prior, key=lambda t: t[1]) if higher_better
+                          else min(prior, key=lambda t: t[1]))
+        if best_v == 0:
+            continue
+        delta_pct = ((latest_v - best_v) / best_v * 100 if higher_better
+                     else (best_v - latest_v) / best_v * 100)
+        regressed = (not latest_cont) and delta_pct < -threshold
+        rows.append({
+            "series": series_name, "metric": key,
+            "rounds": len(points),
+            "latest_round": latest_n, "latest": latest_v,
+            "best_prior_round": best_n, "best_prior": best_v,
+            "delta_pct": round(delta_pct, 2),
+            "direction": "higher" if higher_better else "lower",
+            "contended": latest_cont,
+            "regressed": regressed,
+        })
+        if regressed:
+            regressions.append(rows[-1])
+    return rows, regressions
+
+
+def _results_headlines(root: str):
+    """Headline numerics of unversioned results/*.json (context only)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "results", "*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        nums = {k: v for k, v in doc.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        out.append({"file": os.path.relpath(path, root),
+                    "metric": doc.get("metric"),
+                    "headline": dict(sorted(nums.items())[:6])})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo-root", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent [%(default)s]")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    root = args.repo_root
+
+    all_rows, all_regs = [], []
+    for name, pattern, metrics in (
+            ("bench", "BENCH_r*.json", _BENCH_METRICS),
+            ("multichip", "MULTICHIP_r*.json", _MULTICHIP_METRICS)):
+        rounds = _load_rounds(root, pattern)
+        docs = [(n, d.get("parsed") if name == "bench"
+                 else d.get("bench_mesh")) for n, d in rounds]
+        docs = [(n, d) for n, d in docs if isinstance(d, dict)]
+        rows, regs = _trend(name, docs, metrics, args.threshold)
+        all_rows.extend(rows)
+        all_regs.extend(regs)
+
+    report = {"threshold_pct": args.threshold, "trends": all_rows,
+              "regressions": all_regs,
+              "results": _results_headlines(root),
+              "ok": not all_regs}
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        if not all_rows:
+            print("perf-trend: no comparable rounds found")
+        for r in all_rows:
+            mark = "REGRESSED" if r["regressed"] else (
+                "contended" if r["contended"] else "ok")
+            print(f"[{mark:>9}] {r['series']}/{r['metric']}: "
+                  f"r{r['latest_round']:02d}={r['latest']:,.1f} vs best "
+                  f"prior r{r['best_prior_round']:02d}="
+                  f"{r['best_prior']:,.1f} ({r['delta_pct']:+.1f}%, "
+                  f"{r['direction']}-is-better)")
+        for h in report["results"]:
+            print(f"[  context] {h['file']}: {h['metric'] or '?'}")
+        if all_regs:
+            print(f"perf-trend: {len(all_regs)} metric(s) regressed "
+                  f"beyond {args.threshold:.0f}%")
+    if not all_rows:
+        return 2
+    return 1 if all_regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

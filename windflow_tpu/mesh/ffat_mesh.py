@@ -468,6 +468,26 @@ class FfatMeshReplica(TPUReplicaBase):
         # represent them; counted ignored, a documented anchor divergence)
         live = panes >= 0
         dropped = n - int(live.sum())
+        # unified late accounting, arrival side: anchor drops are counted
+        # records+dropped here; rows behind this batch's watermark are
+        # counted records-only — the per-key drop decision is deferred to
+        # the device program, whose count rides the existing fire
+        # readback in _run_steps (drop-only there, no double count and
+        # NO new host sync)
+        st = self.stats
+        ts_live = batch.ts_host[:n][live] if dropped else batch.ts_host[:n]
+        panes_live = panes[live] if dropped else panes
+        # behind this batch's watermark, OR behind the replica's fire
+        # frontier (a slower input channel's wm can trail it; the device
+        # drop rule compares against per-key next_fire ≤ frontier, so
+        # this mask is a strict superset of every deferred device drop)
+        late_mask = (ts_live < batch.wm) | (panes_live < self._frontier)
+        n_late_seen = int(late_mask.sum())
+        if n_late_seen or dropped:
+            st.note_late(n_late_seen + dropped, dropped,
+                         batch.wm - ts_live[late_mask]
+                         if st.hist_lateness is not None and n_late_seen
+                         else None)
         if dropped:
             self.stats.inputs_ignored += dropped
             keys, panes = keys[live], panes[live]
@@ -643,6 +663,11 @@ class FfatMeshReplica(TPUReplicaBase):
             n_late = int(out[9])
             if n_late:
                 self.stats.inputs_ignored += n_late
+                # in-program late count riding the existing readback:
+                # drop-only — these rows were already counted into
+                # late_records at arrival (every device-dropped pane sits
+                # behind the watermark frontier of its batch)
+                self.stats.note_late(0, n_late)
             self._emit_fired(out[5], out[6], out[7])
             off = hi
             if off >= total:

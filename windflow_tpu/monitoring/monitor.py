@@ -268,6 +268,37 @@ _PROM_SCALARS = (
     ("windflow_tier_miss_rate", "gauge",
      "Fraction of distinct batch keys absent from the hot tier",
      "Tier_miss_rate", 1),
+    # event-time health plane: watermark progress + late-record accounting
+    # (uniform across CPU window engines, FFAT TPU/mesh and fused chains;
+    # conservation: inputs == on_time + late_admitted + late_dropped)
+    ("windflow_watermark_timestamp_usec", "gauge",
+     "Current watermark of the replica (event-time microseconds)",
+     "Watermark_current_ts", 1),
+    ("windflow_watermark_advances_total", "counter",
+     "Watermark advances observed by the replica",
+     "Watermark_advances", 1),
+    ("windflow_watermark_lag_seconds", "gauge",
+     "Wall-clock time since the replica's watermark last advanced",
+     "Watermark_lag_usec", 1e-6),
+    ("windflow_watermark_event_lag_seconds", "gauge",
+     "Event-time gap between the max source timestamp seen and the "
+     "current watermark (event-time source paths only)",
+     "Watermark_event_lag_usec", 1e-6),
+    ("windflow_watermark_idle", "gauge",
+     "1 when no inputs arrived since the watermark last advanced "
+     "(idle, not stalled)", "Watermark_idle", 1),
+    ("windflow_watermark_stalls_total", "counter",
+     "Watermark stall episodes: frozen past WF_WM_STALL_SEC while "
+     "inputs kept arriving", "Watermark_stalls", 1),
+    ("windflow_late_records_total", "counter",
+     "Tuples observed behind the watermark/fired-window frontier",
+     "Late_records", 1),
+    ("windflow_late_dropped_total", "counter",
+     "Late tuples discarded (behind the allowed-lateness frontier)",
+     "Late_dropped", 1),
+    ("windflow_late_admitted_total", "counter",
+     "Late tuples still admitted into window state (within lateness)",
+     "Late_admitted", 1),
 )
 
 # per-operator merged histograms: (family, HELP, stats hist field)
@@ -281,6 +312,9 @@ _PROM_HISTS = (
     ("windflow_e2e_latency_usec",
      "Sampled end-to-end tuple latency recorded at sinks",
      "Latency_e2e_hist"),
+    ("windflow_lateness_usec",
+     "Observed lateness (watermark - ts) of late tuples",
+     "Latency_lateness_hist"),
 )
 
 
@@ -510,6 +544,50 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             lines.append(f"# HELP {fam} {help_}")
             lines.append(f"# TYPE {fam} {typ}")
             lines.extend(body)
+    # pipeline doctor (monitoring/doctor.py): bottleneck attribution over
+    # tick-over-tick deltas — findings count + per-finding scores + an
+    # info-style bottleneck series (verdict rides in a label; alert on
+    # windflow_doctor_healthy == 0 sustained)
+    doctor = snapshot.get("doctor") or {}
+    dr_healthy, dr_findings, dr_scores, dr_info = [], [], [], []
+    for graph, diag in doctor.items():
+        if not isinstance(diag, dict):
+            continue
+        g = _prom_escape(graph)
+        dr_healthy.append(f'windflow_doctor_healthy{{graph="{g}"}} '
+                          f'{1 if diag.get("healthy") else 0}')
+        finds = diag.get("findings") or []
+        dr_findings.append(f'windflow_doctor_findings{{graph="{g}"}} '
+                           f'{len(finds)}')
+        for fnd in finds:
+            o = _prom_escape(fnd.get("operator", "?"))
+            v = _prom_escape(fnd.get("verdict", "?"))
+            dr_scores.append(
+                f'windflow_doctor_verdict_score{{graph="{g}",'
+                f'operator="{o}",verdict="{v}"}} '
+                f'{float(fnd.get("score", 0)):g}')
+        top = diag.get("bottleneck")
+        if isinstance(top, dict):
+            dr_info.append(
+                f'windflow_doctor_bottleneck_info{{graph="{g}",'
+                f'operator="{_prom_escape(top.get("operator", "?"))}",'
+                f'verdict="{_prom_escape(top.get("verdict", "?"))}"}} 1')
+    for fam, typ, help_, body in (
+            ("windflow_doctor_healthy", "gauge",
+             "1 when the pipeline doctor found no bottleneck this tick",
+             dr_healthy),
+            ("windflow_doctor_findings", "gauge",
+             "Doctor findings emitted for the last tick", dr_findings),
+            ("windflow_doctor_verdict_score", "gauge",
+             "Severity score of each doctor finding (per operator and "
+             "verdict)", dr_scores),
+            ("windflow_doctor_bottleneck_info", "gauge",
+             "Top-ranked doctor finding (operator + verdict in labels)",
+             dr_info)):
+        if body:
+            lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {typ}")
+            lines.extend(body)
     # compile attribution: the LAST retrace-triggering abstract signature
     # per replica as an info-style series (the string rides in a label;
     # the retrace-storm query is rate(windflow_compile_total) paired with
@@ -613,6 +691,11 @@ class MonitoringServer:
         self.svgs: Dict[str, str] = {}  # rendered dataflow SVG per graph
         self.reports: Dict[str, Any] = {}
         self.n_reports = 0
+        # pipeline doctor: reports arrive ~1 Hz per graph; diagnosing on
+        # arrival (vs on query) gives every scrape a consistent tick delta
+        from .doctor import PipelineDoctor
+        self._doctor = PipelineDoctor()
+        self.diagnoses: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -646,6 +729,13 @@ class MonitoringServer:
                     elif msg.get("type") == "report":
                         self.reports[msg["graph"]] = msg["stats"]
                         self.n_reports += 1
+                        try:
+                            diag = self._doctor.observe(msg["graph"],
+                                                        msg["stats"])
+                            if diag is not None:
+                                self.diagnoses[msg["graph"]] = diag
+                        except Exception:
+                            pass  # a malformed report must not kill intake
         except OSError:
             pass
         finally:
@@ -659,6 +749,7 @@ class MonitoringServer:
             return {"diagrams": dict(self.diagrams),
                     "svgs": dict(self.svgs),
                     "reports": dict(self.reports),
+                    "doctor": dict(self.diagnoses),
                     "n_reports": self.n_reports}
 
     # -- web view (the reference ships a Spring+React dashboard; this is
@@ -673,6 +764,9 @@ class MonitoringServer:
         GET /metrics -> Prometheus text exposition (counters, queue
                         gauges, per-operator latency histograms); 503
                         until the first graph report arrives
+        GET /doctor  -> pipeline-doctor diagnosis per graph (ranked
+                        bottleneck verdicts over the last report tick);
+                        503 until two reports give a delta
         GET /trace?ms=N -> capture N ms of flight-recorder events from
                         every in-process graph, returned as Chrome
                         trace-event JSON (requires the recorder enabled
@@ -721,6 +815,15 @@ class MonitoringServer:
                         self._send(200, prometheus_text(snap),
                                    "text/plain; version=0.0.4; "
                                    "charset=utf-8")
+                elif self.path == "/doctor":
+                    if not snap.get("doctor"):
+                        # one report gives no delta to diagnose; mirror
+                        # the /metrics not-ready contract
+                        self._send(503, json.dumps(
+                            {"error": "no diagnosis yet: need two "
+                             "monitoring reports for a tick delta"}))
+                    else:
+                        self._send(200, json.dumps(snap["doctor"]))
                 elif self.path.startswith("/trace"):
                     from urllib.parse import parse_qs, urlparse
                     from .flightrec import capture_trace

@@ -16,10 +16,22 @@ and the emitter-side FIFOs.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional
 
 _EWMA_ALPHA = 0.1
+
+
+def _wm_stall_sec() -> float:
+    """Watermark stall threshold (``WF_WM_STALL_SEC``): a replica whose
+    watermark has not advanced for this long WHILE inputs keep arriving is
+    event-time-stalled (frozen source watermark, wedged punctuation path).
+    Quiet replicas (no new inputs either) are ``idle``, never stalled."""
+    try:
+        return max(0.1, float(os.environ.get("WF_WM_STALL_SEC", "5")))
+    except ValueError:
+        return 5.0
 
 
 class StatsRecord:
@@ -91,6 +103,26 @@ class StatsRecord:
         "tier_enabled", "tier_hot_keys", "tier_cold_keys",
         "tier_promotes", "tier_demotes", "tier_promote_usec_total",
         "tier_lookups", "tier_misses",
+        # event-time health plane: watermark progress gauges + unified
+        # late-record accounting. ``wm_current``/``wm_advances`` are the
+        # only hot-path writes (two stores on ADVANCE only, in
+        # BasicReplica._advance_wm); lag/idle/stall derive at poll time
+        # (to_dict / worker idle tick) so the per-tuple path stays flat.
+        # ``wm_max_source_ts`` is tracked only on explicit event-time
+        # source paths (push_with_timestamp / push_columns(ts=...)) —
+        # ingress time has wm == ts, so event lag is identically zero
+        "wm_current", "wm_advances", "wm_max_source_ts", "wm_stalls",
+        "_wm_seen_advances", "_wm_mark_mono", "_wm_inputs_at_mark",
+        "_wm_stalled", "_wm_idle", "_wm_stall_usec",
+        # unified late-record accounting (every window engine: CPU keyed /
+        # persistent / interval join / FFAT CPU / TPU / mesh / fused
+        # terminators). late_records counts tuples that arrived behind the
+        # watermark (or behind a fired window boundary); late_dropped the
+        # subset discarded. Late_admitted derives (records - dropped), so
+        # engines whose drop decision is deferred to a device program
+        # (mesh FFAT) can count arrivals and drops at different sites and
+        # the conservation invariant still holds at the totals
+        "late_records", "late_dropped", "hist_lateness",
         "is_terminated", "_last_svc_start",
         # EWMA seeding: value==0.0 is NOT a reliable "unseeded" sentinel
         # (a genuine ~0 first sample would re-seed forever, biasing early
@@ -188,6 +220,19 @@ class StatsRecord:
         self.tier_promote_usec_total = 0.0
         self.tier_lookups = 0
         self.tier_misses = 0
+        # -- event-time health plane ----------------------------------------
+        self.wm_current = 0
+        self.wm_advances = 0
+        self.wm_max_source_ts = 0
+        self.wm_stalls = 0
+        self._wm_seen_advances = 0
+        self._wm_mark_mono = self.start_time
+        self._wm_inputs_at_mark = 0
+        self._wm_stalled = False
+        self._wm_idle = True
+        self._wm_stall_usec = _wm_stall_sec() * 1e6
+        self.late_records = 0
+        self.late_dropped = 0
         self.is_terminated = False
         self._last_svc_start = 0.0
         self._svc_seeded = False
@@ -207,11 +252,13 @@ class StatsRecord:
             self.hist_prep: Optional[Any] = LatencyHistogram()
             self.hist_commit: Optional[Any] = LatencyHistogram()
             self.hist_e2e: Optional[Any] = LatencyHistogram()
+            self.hist_lateness: Optional[Any] = LatencyHistogram()
         else:
             self.hist_service = None
             self.hist_prep = None
             self.hist_commit = None
             self.hist_e2e = None
+            self.hist_lateness = None
         # -- queue / backpressure gauges ------------------------------------
         self.input_channel = None  # wired by PipeGraph._make_workers
         self.pipe_depth_max = 0  # emitter-side FIFO high-water mark
@@ -383,6 +430,57 @@ class StatsRecord:
         self.shed_records += n
         self.shed_bytes += nbytes
 
+    # -- event-time health plane ---------------------------------------------
+    def note_late(self, n_records: int, n_dropped: int = 0,
+                  lateness_us: Any = None) -> None:
+        """Late-record accounting for one engine decision (or one batched
+        block of decisions). ``n_records`` tuples observed behind the
+        watermark / a fired boundary; ``n_dropped`` of the replica's late
+        tuples discarded. The two may be counted at DIFFERENT call sites
+        (device engines learn the drop count from a later readback), so
+        pass ``n_records=0`` for drop-only updates of tuples already
+        counted late on arrival. ``lateness_us`` — observed (wm - ts),
+        scalar or array — feeds the lateness histogram when tracing is on."""
+        self.late_records += n_records
+        self.late_dropped += n_dropped
+        h = self.hist_lateness
+        if h is not None and lateness_us is not None:
+            if hasattr(lateness_us, "__len__"):
+                h.record_many(lateness_us)
+            else:
+                h.record(lateness_us)
+        if self.recorder is not None and n_dropped:
+            self.recorder.event("late:drop", 0.0, n_dropped)
+
+    def poll_watermark(self, now: Optional[float] = None) -> float:
+        """Derive watermark lag / idle / stall from the advance counter —
+        called at observation points (to_dict, worker idle ticks), never
+        per tuple. Returns the wall-clock lag in microseconds since the
+        watermark last advanced. Stall detection is edge-triggered: a
+        replica whose inputs keep arriving while the watermark has been
+        frozen past ``WF_WM_STALL_SEC`` bumps ``wm_stalls`` once per
+        freeze (and logs a ``wm:stall`` flight-recorder span); a replica
+        with no new inputs either is ``idle``, not stalled."""
+        if now is None:
+            now = time.monotonic()
+        adv = self.wm_advances
+        if adv != self._wm_seen_advances:
+            self._wm_seen_advances = adv
+            self._wm_mark_mono = now
+            self._wm_inputs_at_mark = self.inputs_received
+            self._wm_stalled = False
+            self._wm_idle = False
+            return 0.0
+        lag_us = max(0.0, (now - self._wm_mark_mono) * 1e6)
+        self._wm_idle = self.inputs_received == self._wm_inputs_at_mark
+        if (not self._wm_idle and not self._wm_stalled
+                and lag_us > self._wm_stall_usec):
+            self._wm_stalled = True
+            self.wm_stalls += 1
+            if self.recorder is not None:
+                self.recorder.event("wm:stall", lag_us, self.wm_current)
+        return lag_us
+
     # -- latency tracing -----------------------------------------------------
     def note_e2e(self, us: float) -> None:
         """End-to-end latency of one traced tuple (sink side)."""
@@ -485,6 +583,20 @@ class StatsRecord:
             "Worker_last_error": self.worker_last_error,
             "isTerminated": self.is_terminated,
         }
+        # -- event-time health plane (always present: zero lag on a healthy
+        # replica is itself the signal the doctor reads) --------------------
+        wm_lag_us = self.poll_watermark()
+        d["Watermark_current_ts"] = self.wm_current
+        d["Watermark_advances"] = self.wm_advances
+        d["Watermark_lag_usec"] = round(wm_lag_us, 1)
+        d["Watermark_event_lag_usec"] = (
+            max(0, self.wm_max_source_ts - self.wm_current)
+            if self.wm_max_source_ts > 0 else 0)
+        d["Watermark_idle"] = 1 if self._wm_idle else 0
+        d["Watermark_stalls"] = self.wm_stalls
+        d["Late_records"] = self.late_records
+        d["Late_dropped"] = self.late_dropped
+        d["Late_admitted"] = max(0, self.late_records - self.late_dropped)
         # -- mesh execution plane (mesh replicas only: a Mesh_* series on
         # every CPU replica would be noise — /metrics renders these only
         # where rep.get(field) exists) ---------------------------------------
@@ -529,7 +641,8 @@ class StatsRecord:
         for label, h in (("service", self.hist_service),
                          ("prep", self.hist_prep),
                          ("commit", self.hist_commit),
-                         ("e2e", self.hist_e2e)):
+                         ("e2e", self.hist_e2e),
+                         ("lateness", self.hist_lateness)):
             on = h is not None
             d[f"Latency_{label}_p50_usec"] = round(h.p50, 1) if on else 0.0
             d[f"Latency_{label}_p90_usec"] = round(h.p90, 1) if on else 0.0

@@ -20,7 +20,12 @@ dependency-free client served by ``MonitoringServer.serve_http``: it polls
 - a degraded badge while the recovery plane runs with excluded devices
   (device-loss failover), with the last restore's ladder depth,
 - the dataflow SVG diagram (server-sanitized),
-- per-replica drill-down on click.
+- per-replica drill-down on click,
+- event-time health: a watermark-lag column + late-records column with a
+  drop badge, late-drop markers on the p99 sparkline (orange ticks where
+  ``Late_dropped`` advanced), and a pipeline-doctor verdict banner
+  (ranked bottleneck attribution from the server-side diagnosis that
+  rides in every ``/json`` snapshot).
 """
 
 CLIENT_HTML = r"""<!DOCTYPE html>
@@ -44,17 +49,21 @@ CLIENT_HTML = r"""<!DOCTYPE html>
  #diagram svg { max-width:100%; }
  tr.rep { background:#f7fbff; font-size:11px; }
  .muted { color:#777; font-size:11px; }
+ #doctor { margin:6px 0; padding:5px 10px; border-radius:6px;
+           font-size:12px; background:#e6f4ea; display:none; }
+ #doctor.sick { background:#fdecd2; }
 </style>
 </head>
 <body>
 <h1>windflow_tpu dashboard <span id="conn" class="muted"></span></h1>
 <div class="tabs" id="tabs"></div>
 <div id="badges"></div>
+<div id="doctor"></div>
 <canvas id="spark" width="720" height="80"></canvas>
 <div class="muted">total tuples/s (last 120 s)</div>
 <canvas id="sparklat" width="720" height="60"></canvas>
 <div class="muted">worst p99 end-to-end latency µs (sampled tracing;
-flat at 0 when sampling is off)</div>
+flat at 0 when sampling is off) — ⇅ rescale, ✕ late drops</div>
 <div id="ops"></div>
 <details open id="diagram"><summary>dataflow graph</summary></details>
 <script>
@@ -65,6 +74,8 @@ const hist = {};               // graph -> [throughput samples]
 const lhist = {};              // graph -> [p99 e2e latency samples]
 const rmark = {};              // graph -> [bool: rescale at this sample]
 const rseen = {};              // graph -> last Rescale_events count
+const dmark = {};              // graph -> [bool: late drops this sample]
+const dseen = {};              // graph -> last Late_dropped total
 const open = new Set();        // operator names with replica drill-down
 function fmt(n){ return (n===undefined||n===null)?"":
   Number(n).toLocaleString("en-US",{maximumFractionDigits:1}); }
@@ -94,6 +105,7 @@ function render(snap){
     `${Object.keys(st.Worker_errors).length} worker(s)</span>` : "");
   let total = 0, worstP99 = 0, rows = [];
   let tierHot = 0, tierCold = 0, tierMiss = 0, tierOn = false;
+  let lateRecs = 0, lateDrop = 0, worstWmLag = 0;
   opNames = (st.Operators||[]).map(o=>o.name);
   (st.Operators||[]).forEach((o, oi) => {
     const r = o.replicas, s = (k)=>r.reduce((a,x)=>a+(x[k]||0),0);
@@ -105,6 +117,12 @@ function render(snap){
       tierMiss = Math.max(tierMiss, m("Tier_miss_rate"));
     }
     worstP99 = Math.max(worstP99, m("Latency_e2e_p99_usec"));
+    const wmLagMs = m("Watermark_lag_usec")/1000;
+    // idle replicas park their watermark by design; only flag lag where
+    // traffic is flowing (mirrors the doctor's stall condition)
+    if (!r.every(x=>x.Watermark_idle)) worstWmLag =
+      Math.max(worstWmLag, wmLagMs);
+    lateRecs += s("Late_records"); lateDrop += s("Late_dropped");
     rows.push(`<tr onclick="tog(${oi})"><td class=l>${esc(o.name)}</td>`+
       `<td class=l>${esc(o.kind)}</td><td>${o.parallelism|0}</td>`+
       `<td>${fmt(s("Inputs_received"))}</td>`+
@@ -113,6 +131,9 @@ function render(snap){
       `<td>${fmt(m("Service_time_usec"))}</td>`+
       `<td>${fmt(m("Latency_service_p99_usec"))}</td>`+
       `<td>${fmt(m("Latency_e2e_p99_usec"))}</td>`+
+      `<td>${fmt(wmLagMs)}</td>`+
+      `<td>${fmt(s("Late_records"))}`+
+      `${s("Late_dropped")?" ("+fmt(s("Late_dropped"))+"✕)":""}</td>`+
       `<td>${fmt(m("Checkpoint_cut_pause_usec"))}</td>`+
       `<td>${fmt(m("Queue_len"))}/${fmt(m("Queue_depth_max"))}</td>`+
       `<td>${fmt(s("Device_programs_run"))}</td>`+
@@ -128,6 +149,9 @@ function render(snap){
           `<td>${fmt(x.Service_time_usec)}</td>`+
           `<td>${fmt(x.Latency_service_p99_usec)}</td>`+
           `<td>${fmt(x.Latency_e2e_p99_usec)}</td>`+
+          `<td>${fmt((x.Watermark_lag_usec||0)/1000)}</td>`+
+          `<td>${fmt(x.Late_records)}`+
+          `${x.Late_dropped?" ("+fmt(x.Late_dropped)+"✕)":""}</td>`+
           `<td>${fmt(x.Checkpoint_cut_pause_usec)}</td>`+
           `<td>${fmt(x.Queue_len)}/${fmt(x.Queue_depth_max)}</td>`+
           `<td>${fmt(x.Device_programs_run)}</td>`+
@@ -139,6 +163,10 @@ function render(snap){
     `<table><tr><th class=l>operator</th><th class=l>kind</th><th>par</th>`+
     `<th>in</th><th>out</th><th>ignored</th><th>tuples/s</th>`+
     `<th>svc µs</th><th>svc p99</th><th>e2e p99</th>`+
+    `<th title="wall-clock time since the watermark last advanced">`+
+    `wm lag ms</th>`+
+    `<th title="tuples behind the watermark (✕ = dropped past the `+
+    `allowed lateness)">late</th>`+
     `<th title="barrier cut pause (state capture + ack) of the last `+
     `checkpoint">cut µs</th><th>queue</th>`+
     `<th>device progs</th><th>compiles/hits</th><th>pool hits</th></tr>`+
@@ -188,6 +216,31 @@ function render(snap){
   if (tierOn) el("badges").innerHTML +=
     `<span class=badge>tiered: ${fmt(tierHot)} hot / `+
     `${fmt(tierCold)} cold · miss ${(tierMiss*100).toFixed(1)}%</span>`;
+  // late-drop markers: a tick on the p99 sparkline wherever the graph's
+  // Late_dropped total advanced between polls, plus a warn badge with
+  // the running dropped/seen-late split
+  (dmark[current] = dmark[current]||[]).push(
+    lateDrop > (dseen[current]|0));
+  dseen[current] = lateDrop;
+  if (dmark[current].length > 120) dmark[current].shift();
+  if (lateRecs) el("badges").innerHTML +=
+    `<span class="badge ${lateDrop?'warn':''}">late ${fmt(lateRecs)}`+
+    (lateDrop? ` (dropped ${fmt(lateDrop)})` : "")+`</span>`;
+  if (worstWmLag > 1000) el("badges").innerHTML +=
+    `<span class="badge warn">wm lag ${fmt(worstWmLag)}ms</span>`;
+  // pipeline-doctor banner: the server diagnoses every report tick; the
+  // banner shows the ranked verdict for the selected graph
+  const doc = el("doctor"), diag = (snap.doctor||{})[current];
+  if (diag) {
+    doc.style.display = "block";
+    doc.className = diag.healthy ? "" : "sick";
+    const finds = (diag.findings||[]).slice(0,3).map(f =>
+      `<b>${esc(f.operator)}</b> ${esc(f.verdict)}`+
+      (f.by? `&nbsp;→ <b>${esc(f.by)}</b>` : "")+
+      ` <span class=muted>[${fmt(f.score)}]</span>`).join(" · ");
+    doc.innerHTML = `doctor: ${esc(diag.summary||"")}`+
+      (finds? `<br>${finds}` : "");
+  } else { doc.style.display = "none"; }
   const dlq = st.Dead_letters|0;
   if (dlq) el("badges").innerHTML +=
     `<span class="badge warn">dead letters ${fmt(dlq)}</span>`;
@@ -204,29 +257,33 @@ function render(snap){
         ? ` (admit ${fmt(ov.Overload_admit_rate_tps)}/s)` : "")+
       ((ov.Overload_shed_records|0) > 0
         ? ` — shed ${fmt(ov.Overload_shed_records)}` : "")+`</span>`;
-  sparkLine("sparklat", lhist[current], "#b0452b", "µs", rmark[current]);
+  sparkLine("sparklat", lhist[current], "#b0452b", "µs", rmark[current],
+            dmark[current]);
   const svg = (snap.svgs||{})[current];  // server-sanitized
   el("diagram").innerHTML = "<summary>dataflow graph</summary>"+
     (svg || "<pre>"+esc(snap.diagrams[current]||"")+"</pre>");
 }
 function spark(h){ sparkLine("spark", h, "#2b6cb0", " t/s"); }
-function sparkLine(id, h, color, unit, marks){
+function tickMarks(ctx, c, marks, color, glyph){
+  ctx.strokeStyle = color; ctx.lineWidth = 1;
+  marks.forEach((m,i)=>{
+    if (!m) return;
+    const x = i*(c.width/120);
+    ctx.beginPath(); ctx.setLineDash([3,3]);
+    ctx.moveTo(x, 2); ctx.lineTo(x, c.height-2); ctx.stroke();
+    ctx.setLineDash([]);
+    ctx.fillStyle = color; ctx.font = "9px monospace";
+    ctx.fillText(glyph, Math.min(x+2, c.width-10), c.height-4);
+  });
+}
+function sparkLine(id, h, color, unit, marks, marks2){
   const c = el(id), ctx = c.getContext("2d");
   ctx.clearRect(0,0,c.width,c.height);
   if (!h.length) return;
   const max = Math.max(...h, 1);
-  if (marks){  // vertical ticks: one per rescale event in the window
-    ctx.strokeStyle = "#7a5cb0"; ctx.lineWidth = 1;
-    marks.forEach((m,i)=>{
-      if (!m) return;
-      const x = i*(c.width/120);
-      ctx.beginPath(); ctx.setLineDash([3,3]);
-      ctx.moveTo(x, 2); ctx.lineTo(x, c.height-2); ctx.stroke();
-      ctx.setLineDash([]);
-      ctx.fillStyle = "#7a5cb0"; ctx.font = "9px monospace";
-      ctx.fillText("⇅", Math.min(x+2, c.width-10), c.height-4);
-    });
-  }
+  // vertical ticks: rescale events (purple) and late-drop surges (orange)
+  if (marks) tickMarks(ctx, c, marks, "#7a5cb0", "⇅");
+  if (marks2) tickMarks(ctx, c, marks2, "#d97706", "✕");
   ctx.beginPath(); ctx.strokeStyle = color; ctx.lineWidth = 1.6;
   h.forEach((v,i)=>{
     const x = i*(c.width/120), y = c.height-4-(v/max)*(c.height-12);

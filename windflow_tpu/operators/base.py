@@ -140,8 +140,13 @@ class BasicReplica:
         self.stats.start_svc()
         n = 1
         if msg.is_punct:
-            self.stats.punct_received += 1
+            st = self.stats
+            st.punct_received += 1
             self._advance_wm(msg.wm)
+            # wm:advance spans ride punctuations only (bounded rate) —
+            # per-tuple advances would flood the ring
+            if st.recorder is not None and msg.wm >= self.cur_wm:
+                st.recorder.event("wm:advance", 0.0, self.cur_wm)
             self.on_punctuation(msg.wm)
         elif isinstance(msg, Batch):
             n = msg.size
@@ -188,6 +193,11 @@ class BasicReplica:
     def _advance_wm(self, wm: int) -> None:
         if wm > self.cur_wm:
             self.cur_wm = wm
+            # event-time health gauges: two stores on ADVANCE only; lag,
+            # idle and stall detection derive at poll time (stats.py)
+            st = self.stats
+            st.wm_current = wm
+            st.wm_advances += 1
 
     # -- hooks ---------------------------------------------------------------
     def process(self, payload: Any, ts: int, wm: int, tag: int) -> None:
@@ -216,6 +226,7 @@ class BasicReplica:
         """Inverse of ``snapshot_state``; called after ``build_replicas``
         (emitter/collector wiring done) and before any worker starts."""
         self.cur_wm = state.get("cur_wm", 0)
+        self.stats.wm_current = self.cur_wm
 
     def terminate(self) -> None:
         if self.terminated:

@@ -41,7 +41,11 @@ class SourceShipper:
     def push_with_timestamp(self, payload: Any, ts: int) -> None:
         if self._r.op.time_policy is not TimePolicy.EVENT_TIME:
             raise WindFlowError("push_with_timestamp() requires EVENT_TIME")
-        self._r.ship(payload, int(ts), self._next_wm)
+        ts = int(ts)
+        st = self._r.stats
+        if ts > st.wm_max_source_ts:  # event-time lag numerator
+            st.wm_max_source_ts = ts
+        self._r.ship(payload, ts, self._next_wm)
 
     def set_next_watermark(self, wm: int) -> None:
         if wm < self._next_wm:
@@ -80,6 +84,10 @@ class SourceShipper:
             ts_arr = np.asarray(ts, dtype=np.int64)
             if len(ts_arr) != n:
                 raise WindFlowError("push_columns: ts length mismatch")
+            st = self._r.stats
+            m = int(ts_arr.max())
+            if m > st.wm_max_source_ts:  # event-time lag numerator
+                st.wm_max_source_ts = m
             wm = self._next_wm
         self._r.ship_columns(cols, ts_arr, wm)
 
@@ -283,6 +291,9 @@ class SourceReplica(BasicReplica):
             return
         if wm > self.cur_wm:
             self.cur_wm = wm
+            st = self.stats
+            st.wm_current = wm
+            st.wm_advances += 1
         self._emit_admitted(payload, ts)
 
     def _emit_admitted(self, payload: Any, ts: int) -> None:
@@ -315,6 +326,8 @@ class SourceReplica(BasicReplica):
                     return
         if wm > self.cur_wm:
             self.cur_wm = wm
+            self.stats.wm_current = wm
+            self.stats.wm_advances += 1
         st = self.stats
         n = len(ts_arr)
         base = st.inputs_received

@@ -122,6 +122,10 @@ class WindowEngine:
         self.key_map: Dict[Any, _KeyDesc] = {}
         self.ignored_tuples = 0
         self.cur_wm = 0
+        # unified late accounting (event-time health plane): the owning
+        # replica wires its StatsRecord here; None (bare engine in unit
+        # tests) keeps the engine standalone
+        self.stats = None
         # Reference-compat TB numbering (wf/window_replica.hpp:253-283):
         # when set, a key's windows are anchored at this time origin (not
         # its first tuple), and every window between the origin and the
@@ -180,7 +184,19 @@ class WindowEngine:
             if kd.last_fired_lwid >= 0 or (self.tb_origin is not None
                                            and index < self.tb_origin):
                 self.ignored_tuples += 1
+                st = self.stats
+                if st is not None:
+                    st.note_late(1, 1, float(wm - ts)
+                                 if self.win_type is WinType.TB and wm > ts
+                                 else None)
             return
+        # admitted-late: a TB tuple behind the watermark that still lands
+        # in an open window (within the allowed lateness). Dropped late
+        # tuples returned above, so the two sites classify disjointly and
+        # inputs == on_time + late_admitted + late_dropped holds exactly
+        st = self.stats
+        if st is not None and self.win_type is WinType.TB and ts < wm:
+            st.note_late(1, 0, float(wm - ts))
         # open every window whose range has been reached
         if self.win_len >= self.slide_local:  # sliding / tumbling
             last_w = math.ceil((index + 1 - initial) / self.slide_local) - 1

@@ -38,6 +38,9 @@ class _WindowReplica(BasicReplica):
     def __init__(self, op: "_WindowOperatorBase", idx: int) -> None:
         super().__init__(op, idx)
         self.engine = op._make_engine(idx, self.context)
+        # unified late accounting: the engine classifies every tuple as
+        # on-time / late-admitted / late-dropped against this record
+        self.engine.stats = self.stats
 
     def _emit_cb(self, payload: Any, ts: int, wm: int,
                  msg_id: Optional[int]) -> None:

@@ -811,6 +811,25 @@ class FfatTPUReplica(TPUReplicaBase):
         live = leaves >= nf
         n_live = int(live.sum())
         n_late = n_rows - n_live
+        # unified late accounting: this host-side mask is the SAME
+        # late/sentinel classification the packed composite below encodes
+        # for the device program — export it instead of discarding it.
+        # TB: every dropped row sits behind the fired-window frontier,
+        # hence behind the watermark, so late_records ⊇ late_dropped and
+        # Late_admitted = records - dropped stays exact
+        st = self.stats
+        if op.win_type is WinType.TB:
+            late_mask = ts_rows < batch.wm
+            if n_late:
+                late_mask = late_mask | ~live
+            n_late_seen = int(late_mask.sum())
+            if n_late_seen:
+                st.note_late(n_late_seen, n_late,
+                             batch.wm - ts_rows[late_mask]
+                             if st.hist_lateness is not None else None)
+        elif n_late:
+            # CB: order-based drops (gap windows / re-registered keys)
+            st.note_late(n_late, n_late)
         if n_late:
             self.ignored += n_late
             self.stats.inputs_ignored += n_late
